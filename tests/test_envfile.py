@@ -1,0 +1,34 @@
+"""setenv-registry tests (reference contract: /mnt/shared/setenv, sourced
+everywhere — install_gcc-8.2.sh:34-41, run-tf-sing-ucx-openmpi.sh:14)."""
+
+import subprocess
+
+from tpu_hc_bench import envfile
+
+
+def test_register_and_read(tmp_path):
+    p = tmp_path / "setenv"
+    envfile.register("jax", {"TPU_HC_BENCH_FABRIC": "ici"}, path=p)
+    envfile.register("data", {"TPU_HC_BENCH_DATA_DIR": "/mnt/data"}, path=p)
+    env = envfile.read(p)
+    assert env["TPU_HC_BENCH_FABRIC"] == "ici"
+    assert env["TPU_HC_BENCH_DATA_DIR"] == "/mnt/data"
+
+
+def test_reregister_replaces_not_duplicates(tmp_path):
+    p = tmp_path / "setenv"
+    envfile.register("jax", {"A": "1"}, path=p)
+    envfile.register("jax", {"A": "2"}, path=p)
+    text = p.read_text()
+    assert text.count("export A=") == 1
+    assert envfile.read(p)["A"] == "2"
+
+
+def test_file_is_sourceable_by_sh(tmp_path):
+    p = tmp_path / "setenv"
+    envfile.register("t", {"MY_VAR": "hello world", "Q": "it's"}, path=p)
+    out = subprocess.run(
+        ["sh", "-c", f". {p} && printf '%s|%s' \"$MY_VAR\" \"$Q\""],
+        capture_output=True, text=True, check=True,
+    )
+    assert out.stdout == "hello world|it's"

@@ -1,0 +1,43 @@
+"""Fabric selection tests: ib|sock (reference names) and ici|dcn|host."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hc_bench.parallel import fabric
+
+
+def test_reference_aliases():
+    # run-tf-sing-ucx-openmpi.sh:27-30 contract: fabric in {ib, sock}
+    assert fabric.resolve_fabric("ib") is fabric.Fabric.ICI
+    assert fabric.resolve_fabric("sock") is fabric.Fabric.HOST
+
+
+def test_native_names():
+    assert fabric.resolve_fabric("ici") is fabric.Fabric.ICI
+    assert fabric.resolve_fabric("dcn") is fabric.Fabric.DCN
+    assert fabric.resolve_fabric("HOST") is fabric.Fabric.HOST
+
+
+def test_unknown_fabric_raises():
+    with pytest.raises(ValueError):
+        fabric.resolve_fabric("infiniband")
+
+
+def test_fast_flag():
+    assert fabric.Fabric.ICI.is_fast and fabric.Fabric.DCN.is_fast
+    assert not fabric.Fabric.HOST.is_fast
+
+
+def test_env_exports_roundtrip():
+    cfg = fabric.FabricConfig(fabric.Fabric.ICI, 134217728)
+    env = cfg.env_exports()
+    assert env["TPU_HC_BENCH_FABRIC"] == "ici"
+    assert env["TPU_HC_BENCH_FUSION_THRESHOLD"] == "134217728"
+    assert "ici" in cfg.summary()
+
+
+def test_host_allreduce_means_over_leading_axis():
+    tree = {"g": jnp.stack([jnp.full((3,), float(i)) for i in range(8)])}
+    out = fabric.host_allreduce(tree)
+    np.testing.assert_allclose(out["g"], np.full(3, 3.5))

@@ -1,0 +1,81 @@
+"""Flag-surface tests: the reference's exact argv must parse and translate.
+
+The argv below is the literal flag set the reference assembles at
+run-tf-sing-ucx-openmpi.sh:62-81 (SURVEY.md §2d), which our driver must
+honor with TPU-translated semantics.
+"""
+
+from tpu_hc_bench import flags
+
+REFERENCE_ARGV = [
+    "--batch_size", "64",
+    "--num_warmup_batches", "50",
+    "--num_batches", "100",
+    "--model", "resnet50",
+    "--num_intra_threads", "22",
+    "--num_inter_threads", "2",
+    "--kmp_blocktime", "1",
+    "--kmp_affinity", "granularity=fine,noverbose,compact,1,0",
+    "--display_every", "10",
+    "--data_format", "NCHW",
+    "--optimizer", "momentum",
+    "--forward_only", "False",
+    "--device", "cpu",
+    "--mkl", "TRUE",
+    "--variable_update", "horovod",
+    "--horovod_device", "cpu",
+    "--local_parameter_device", "cpu",
+    "--data_name", "imagenet",
+]
+
+
+def test_reference_argv_parses_and_translates():
+    cfg = flags.parse_flags(REFERENCE_ARGV)
+    # experiment knobs preserved verbatim
+    assert cfg.batch_size == 64
+    assert cfg.num_warmup_batches == 50
+    assert cfg.num_batches == 100
+    assert cfg.model == "resnet50"
+    assert cfg.display_every == 10
+    assert cfg.optimizer == "momentum"
+    assert cfg.forward_only is False
+    assert cfg.data_name == "imagenet"
+    # TPU translations applied
+    assert cfg.data_format == "NHWC"
+    assert cfg.device == "tpu"
+    assert cfg.mkl is False
+    assert cfg.variable_update == "psum"
+    assert cfg.horovod_device == "tpu"
+    assert cfg.local_parameter_device == "tpu"
+    # translations recorded for the log banner
+    assert "data_format" in cfg.translations
+    assert "mkl" in cfg.translations
+    assert "variable_update" in cfg.translations
+
+
+def test_defaults_match_reference_constants():
+    cfg = flags.parse_flags([])
+    assert cfg.num_warmup_batches == 50      # run-tf-sing-ucx-openmpi.sh:32
+    assert cfg.num_batches == 100            # :33
+    assert cfg.model == "resnet50"           # :34
+    assert cfg.display_every == 10           # :71
+    assert cfg.fusion_threshold_bytes == 134217728  # :105
+
+
+def test_bool_flag_spellings():
+    for spelling, expected in [("TRUE", True), ("true", True), ("1", True),
+                               ("False", False), ("f", False), ("0", False)]:
+        cfg = flags.parse_flags(["--forward_only", spelling])
+        assert cfg.forward_only is expected
+
+
+def test_fp16_maps_to_bf16():
+    cfg = flags.parse_flags(["--use_fp16", "True"])
+    assert cfg.compute_dtype == "bfloat16"
+    assert flags.parse_flags([]).compute_dtype == "float32"
+
+
+def test_summary_lines_cover_config():
+    cfg = flags.parse_flags(REFERENCE_ARGV)
+    text = "\n".join(cfg.summary_lines())
+    assert "resnet50" in text and "momentum" in text and "translated:" in text

@@ -1,0 +1,35 @@
+"""OSU-equivalent microbench smoke tests on the virtual mesh."""
+
+import pytest
+
+from tpu_hc_bench.microbench import osu
+
+
+@pytest.mark.parametrize("op", osu.OSU_OPS)
+def test_sweep_runs_and_reports(op, mesh8):
+    results = osu.run_sweep(
+        op=op, min_bytes=256, max_bytes=1024, warmup=1, iters=2, mesh=mesh8
+    )
+    assert len(results) == 3  # 256, 512, 1024
+    for r in results:
+        assert r.world_size == 8
+        assert r.mean_us > 0
+        assert r.algbw_gbps > 0
+    sizes = [r.message_bytes for r in results]
+    assert sizes == sorted(sizes)
+
+
+def test_busbw_factors():
+    assert osu._busbw_factor("allreduce", 8) == pytest.approx(2 * 7 / 8)
+    assert osu._busbw_factor("all_gather", 8) == pytest.approx(7 / 8)
+    assert osu._busbw_factor("ppermute", 8) == 1.0
+    assert osu._busbw_factor("allreduce", 1) == 1.0
+
+
+def test_format_table(mesh8):
+    results = osu.run_sweep(
+        op="allreduce", min_bytes=256, max_bytes=256, warmup=1, iters=1,
+        mesh=mesh8,
+    )
+    table = osu.format_table(results)
+    assert "allreduce" in table and "busbw" in table

@@ -1,0 +1,60 @@
+"""Layout-math and mesh tests (reference math: run-tf-sing-ucx-openmpi.sh:37-50)."""
+
+import pytest
+
+from tpu_hc_bench import topology
+
+
+def test_whole_host_mode():
+    # WORKERS_PER_SOCKET=0 -> whole-machine mode (:40-46): all chips
+    lay = topology.compute_layout(num_hosts=4, workers_per_host=0, chips_per_host=8)
+    assert lay.workers_per_host == 8
+    assert lay.total_workers == 32
+
+
+def test_explicit_workers():
+    lay = topology.compute_layout(num_hosts=2, workers_per_host=2, chips_per_host=4)
+    assert lay.total_workers == 4
+    assert lay.global_batch(64) == 256  # per-worker batch semantics
+
+
+def test_layout_validation():
+    with pytest.raises(ValueError):
+        topology.compute_layout(0, 1, 4)
+    with pytest.raises(ValueError):
+        topology.compute_layout(1, 5, 4)  # more workers than chips
+    with pytest.raises(ValueError):
+        topology.compute_layout(1, -1, 4)
+
+
+def test_discover_layout_virtual_devices(devices):
+    lay = topology.discover_layout()
+    assert lay.chips_per_host == 8
+    assert lay.total_workers == 8
+
+
+def test_build_mesh_dp(mesh8):
+    assert mesh8.axis_names == (topology.DATA_AXIS, topology.MODEL_AXIS)
+    assert mesh8.shape[topology.DATA_AXIS] == 8
+    assert mesh8.shape[topology.MODEL_AXIS] == 1
+
+
+def test_build_mesh_hybrid(devices):
+    lay = topology.discover_layout()
+    mesh = topology.build_mesh(lay, model_parallel=2)
+    assert mesh.shape[topology.DATA_AXIS] == 4
+    assert mesh.shape[topology.MODEL_AXIS] == 2
+
+
+def test_select_devices_partial(devices):
+    lay = topology.compute_layout(num_hosts=1, workers_per_host=4, chips_per_host=8)
+    picked = topology.select_devices(lay)
+    assert len(picked) == 4
+    ids = [d.id for d in picked]
+    assert ids == sorted(ids)  # deterministic contiguous pinning
+
+
+def test_summary_banner():
+    lay = topology.compute_layout(4, 1, 8)
+    text = "\n".join(lay.summary_lines(fabric="ici"))
+    assert "num_hosts=4" in text and "total_workers=4" in text

@@ -1,0 +1,72 @@
+"""Generated environment registry — the TPU analog of ``/mnt/shared/setenv``.
+
+The reference's entire configuration system is a file every installer appends
+``export`` lines to (``install-scripts/install_gcc-8.2.sh:34-41``,
+``install_ucx_ompi.sh:29-38``, ``install_conda_tf_hvd.sh:16-18``) and every
+downstream script sources (``benchmark-scripts/run-tf-sing-ucx-openmpi.sh:14``).
+
+This module keeps that contract: components register their environment
+exports into one registry file (default ``~/.tpu_hc_bench/setenv``); launch
+scripts ``source`` it.  Entries are idempotent (keyed by a section tag) so
+re-running a setup step replaces rather than duplicates its block — an
+improvement over the reference's append-only file, which grows on re-install.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+
+DEFAULT_PATH = Path(os.environ.get(
+    "TPU_HC_BENCH_SETENV", str(Path.home() / ".tpu_hc_bench" / "setenv")
+))
+
+_BEGIN = "# >>> tpu_hc_bench:{tag} >>>"
+_END = "# <<< tpu_hc_bench:{tag} <<<"
+
+
+def register(tag: str, exports: dict[str, str], path: Path | None = None) -> Path:
+    """Write/replace a tagged export block in the registry file."""
+    path = Path(path or DEFAULT_PATH)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    begin, end = _BEGIN.format(tag=tag), _END.format(tag=tag)
+    block = "\n".join(
+        [begin] + [f"export {k}={_quote(v)}" for k, v in exports.items()] + [end]
+    )
+    text = path.read_text() if path.exists() else ""
+    pattern = re.compile(
+        re.escape(begin) + r".*?" + re.escape(end), flags=re.DOTALL
+    )
+    if pattern.search(text):
+        text = pattern.sub(block, text)
+    else:
+        if text and not text.endswith("\n"):
+            text += "\n"
+        text += block + "\n"
+    path.write_text(text)
+    return path
+
+
+def read(path: Path | None = None) -> dict[str, str]:
+    """Parse all exports back out (for sanity reporting / tests)."""
+    path = Path(path or DEFAULT_PATH)
+    out: dict[str, str] = {}
+    if not path.exists():
+        return out
+    for line in path.read_text().splitlines():
+        m = re.match(r"export\s+([A-Za-z_][A-Za-z0-9_]*)=(.*)$", line.strip())
+        if m:
+            out[m.group(1)] = _unquote(m.group(2))
+    return out
+
+
+def _quote(v: str) -> str:
+    return "'" + str(v).replace("'", "'\\''") + "'"
+
+
+def _unquote(v: str) -> str:
+    v = v.strip()
+    if len(v) >= 2 and v[0] == v[-1] and v[0] in "'\"":
+        return v[1:-1].replace("'\\''", "'")
+    return v

@@ -1,0 +1,16 @@
+"""ICI micro-benchmarks: the OSU MPI benchmark suite, TPU-native.
+
+Replaces OSU micro-benchmarks 5.6.1 (built by the reference at
+``install-scripts/install_osu_bench.sh:13-17`` and shipped in the ``-osu``
+container, ``tf-hvd-gcc-ompi-ucx-mlnx-osu.def:25-26``) with latency and
+bandwidth sweeps of the XLA collectives that carry the training traffic:
+psum (osu_allreduce), all_gather (osu_allgather), psum_scatter
+(osu_reduce_scatter), and ppermute ring (osu_latency/osu_bw point-to-point
+analog).
+"""
+
+from tpu_hc_bench.microbench.osu import (  # noqa: F401
+    OSU_OPS,
+    SweepResult,
+    run_sweep,
+)

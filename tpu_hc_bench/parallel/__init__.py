@@ -1,0 +1,17 @@
+"""Distributed communication layer: XLA collectives over the ICI/DCN mesh.
+
+TPU-native replacement for the reference's dual MPI stacks (OpenMPI/UCX/HCOLL
+and IntelMPI/libfabric over InfiniBand verbs — SURVEY.md §2b #16-#20) and for
+Horovod's fused gradient allreduce.
+"""
+
+from tpu_hc_bench.parallel.collectives import (  # noqa: F401
+    allreduce_gradients,
+    fused_psum_tree,
+    psum,
+    pmean,
+    all_gather,
+    reduce_scatter,
+    ppermute_ring,
+)
+from tpu_hc_bench.parallel.fabric import Fabric, resolve_fabric  # noqa: F401

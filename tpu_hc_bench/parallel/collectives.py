@@ -1,0 +1,141 @@
+"""Collective wrappers + the Horovod fusion-buffer behavioral port.
+
+The reference's gradient path is Horovod's C++ core: background thread,
+tensor-fusion buffer (128 MiB, ``HOROVOD_FUSION_THRESHOLD=134217728`` at
+``run-tf-sing-ucx-openmpi.sh:105``), ring/hierarchical MPI allreduce over
+UCX/verbs (SURVEY.md §2b #20).  On TPU the allreduce is an XLA collective
+compiled into the training step — no background thread, no MPI — but the
+*fusion* concept survives: small gradient tensors are flattened and
+concatenated into buckets of at most ``fusion_threshold_bytes`` so each
+``psum`` moves one large contiguous buffer over ICI instead of many small
+ones (latency-bound -> bandwidth-bound, exactly Horovod's trick).
+
+These helpers must be called inside a ``jax.shard_map``-ed (or otherwise
+mesh-mapped) function where ``axis_name`` is bound.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tpu_hc_bench.flags import DEFAULT_FUSION_THRESHOLD_BYTES
+from tpu_hc_bench.topology import DATA_AXIS
+
+
+def psum(x: Any, axis_name: str = DATA_AXIS) -> Any:
+    """Sum over the mesh axis — MPI_Allreduce(SUM) / HCOLL equivalent."""
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x: Any, axis_name: str = DATA_AXIS) -> Any:
+    """Mean over the mesh axis — Horovod's default gradient averaging."""
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x: Any, axis_name: str = DATA_AXIS, axis: int = 0) -> Any:
+    """MPI_Allgather equivalent (OSU osu_allgather analog)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reduce_scatter(x: Any, axis_name: str = DATA_AXIS, axis: int = 0) -> Any:
+    """MPI_Reduce_scatter equivalent; the building block of ring allreduce."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ppermute_ring(x: Any, axis_name: str = DATA_AXIS, shift: int = 1) -> Any:
+    """Ring permute — the point-to-point primitive (osu_latency analog).
+
+    Sends each shard to its ``+shift`` ring neighbor over ICI, the XLA
+    counterpart of UCX point-to-point transport (SURVEY.md §2b #16).
+    """
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def _flatten_to_buckets(
+    leaves: Sequence[jax.Array], threshold_bytes: int
+) -> list[list[int]]:
+    """Greedily group leaf indices into buckets of <= threshold bytes.
+
+    A leaf larger than the threshold gets its own bucket (Horovod does the
+    same: oversized tensors bypass the fusion buffer).
+    """
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i, leaf in enumerate(leaves):
+        nbytes = leaf.size * leaf.dtype.itemsize
+        if cur and cur_bytes + nbytes > threshold_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+        if cur_bytes >= threshold_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def fused_psum_tree(
+    tree: Any,
+    axis_name: str = DATA_AXIS,
+    threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
+    average: bool = False,
+) -> Any:
+    """Allreduce a pytree through fusion buckets — Horovod fusion-buffer port.
+
+    Leaves are flattened, concatenated per-bucket (grouped greedily up to
+    ``threshold_bytes``, preserving order), reduced with one ``psum`` per
+    bucket, then split and reshaped back.  Mixed dtypes within a bucket are
+    upcast to the widest float dtype for the wire and cast back on unpack.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    buckets = _flatten_to_buckets(leaves, threshold_bytes)
+    denom = jax.lax.axis_size(axis_name) if average else 1
+    out: list[jax.Array | None] = [None] * len(leaves)
+    for bucket in buckets:
+        wire_dtype = jnp.result_type(*[leaves[i].dtype for i in bucket])
+        flat = jnp.concatenate(
+            [leaves[i].astype(wire_dtype).reshape(-1) for i in bucket]
+        )
+        reduced = jax.lax.psum(flat, axis_name)
+        if average:
+            reduced = reduced / denom
+        offset = 0
+        for i in bucket:
+            n = leaves[i].size
+            out[i] = (
+                reduced[offset : offset + n]
+                .reshape(leaves[i].shape)
+                .astype(leaves[i].dtype)
+            )
+            offset += n
+    return jax.tree.unflatten(treedef, out)
+
+
+def allreduce_gradients(
+    grads: Any,
+    axis_name: str = DATA_AXIS,
+    threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
+    fuse: bool = True,
+) -> Any:
+    """The Horovod DistributedOptimizer step: average grads across workers.
+
+    ``fuse=True`` routes through the fusion buckets; ``fuse=False`` emits one
+    ``pmean`` per leaf and leaves combining to XLA (useful for A/B-ing the
+    fusion port against the compiler, which is the honest TPU default).
+    """
+    if fuse:
+        return fused_psum_tree(
+            grads, axis_name=axis_name, threshold_bytes=threshold_bytes,
+            average=True,
+        )
+    return jax.tree.map(lambda g: jax.lax.pmean(g, axis_name), grads)

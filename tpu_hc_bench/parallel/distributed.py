@@ -1,0 +1,78 @@
+"""Multi-host bring-up: ``jax.distributed`` in place of ORTE/hydra + SSH mesh.
+
+The reference forms a cluster by nmap subnet sweep -> ``nodeips.txt`` ->
+all-to-all passwordless-SSH mesh (``azure-scripts/setup-pwdless-ssh.sh``),
+then ``mpirun -hostfile ~/nodeips.txt`` launches one rank per worker on every
+node (``run-tf-sing-ucx-openmpi.sh:99-109``).
+
+On a TPU pod the control plane already knows the topology: every host runs
+the same program and ``jax.distributed.initialize()`` discovers coordinator,
+process count, and process id from the TPU metadata.  This module keeps the
+*hostfile contract* anyway — a ``nodeips.txt``-style file can drive explicit
+initialization for non-TPU-pod deployments (CPU clusters, tests), playing
+exactly the role the reference file plays for mpirun (:25,101).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import jax
+
+# Default port for the JAX distributed coordinator (no reference analog;
+# ORTE picks its own ports).
+DEFAULT_COORDINATOR_PORT = 9944
+
+# Hostfile contract: one IP/hostname per line, first line = coordinator
+# (the reference's nodeips.txt, setup-pwdless-ssh.sh:32).
+DEFAULT_HOSTFILE = Path.home() / "nodeips.txt"
+
+
+def read_hostfile(path: Path | str | None = None) -> list[str]:
+    """Parse a nodeips.txt-style hostfile (blank lines / #comments skipped)."""
+    p = Path(path or DEFAULT_HOSTFILE)
+    hosts = []
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            hosts.append(line)
+    if not hosts:
+        raise ValueError(f"hostfile {p} contains no hosts")
+    return hosts
+
+
+def initialize(
+    hostfile: Path | str | None = None,
+    process_id: int | None = None,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+) -> None:
+    """Initialize multi-host JAX.
+
+    Resolution order:
+    1. Already initialized -> no-op.
+    2. On a TPU pod (or under a cluster env JAX understands) with no explicit
+       args -> ``jax.distributed.initialize()`` auto-detect.
+    3. Explicit hostfile (+ process_id, or $TPU_HC_BENCH_PROCESS_ID) ->
+       coordinator is the first host, num_processes is the line count —
+       the mpirun-hostfile behavior (run-tf-sing-ucx-openmpi.sh:101).
+    """
+    if jax._src.distributed.global_state.client is not None:  # already up
+        return
+    explicit = hostfile is not None or process_id is not None
+    if not explicit and os.environ.get("TPU_HC_BENCH_HOSTFILE") is None:
+        jax.distributed.initialize()
+        return
+    hosts = read_hostfile(hostfile or os.environ.get("TPU_HC_BENCH_HOSTFILE"))
+    if process_id is None:
+        process_id = int(os.environ["TPU_HC_BENCH_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=f"{hosts[0]}:{coordinator_port}",
+        num_processes=len(hosts),
+        process_id=process_id,
+    )
+
+
+def is_coordinator() -> bool:
+    """True on the rank-0 host (the reference's 'head node' running the launcher)."""
+    return jax.process_index() == 0

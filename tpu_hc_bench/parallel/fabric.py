@@ -1,0 +1,98 @@
+"""Fabric selection: the reference's ``ib|sock`` switch, TPU-native.
+
+The reference launchers take a 4th positional arg ``fabric in {ib, sock}``
+(``run-tf-sing-ucx-openmpi.sh:27-30``): ``ib`` configures the fast path
+(UCX pml, HCOLL collectives, live PKEY read from sysfs, ``:85-92``) and
+``sock`` forces plain TCP (``-mca pml ^ucx``, ``:93-94``) — a slow fallback
+that doubles as the no-InfiniBand smoke test (SURVEY.md §4.4).
+
+TPU translation (BASELINE.json north star): ``ib -> ici`` (XLA collectives
+over the inter-chip interconnect — the compiled fast path) and
+``sock -> host`` (gradients bounced through host memory and reduced on CPU —
+a genuinely slow, genuinely working fallback that exercises the full train
+loop without ICI collectives, exactly the role ``sock`` plays).  ``dcn`` is
+accepted as an alias for the cross-slice case on multi-slice pods, where the
+mesh layout (topology.build_mesh) already puts the host-crossing phase of
+the allreduce on DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class Fabric(enum.Enum):
+    ICI = "ici"    # fast path: XLA collectives over ICI (reference: ib)
+    DCN = "dcn"    # cross-slice collectives ride DCN (multi-slice pods)
+    HOST = "host"  # slow path: host-mediated reduce (reference: sock)
+
+    @property
+    def is_fast(self) -> bool:
+        return self is not Fabric.HOST
+
+
+_ALIASES = {
+    "ib": Fabric.ICI,      # reference fast path maps to ICI
+    "ici": Fabric.ICI,
+    "dcn": Fabric.DCN,
+    "sock": Fabric.HOST,   # reference slow/TCP path maps to host bounce
+    "host": Fabric.HOST,
+}
+
+
+def resolve_fabric(name: str) -> Fabric:
+    """Accept both reference (``ib|sock``) and native (``ici|dcn|host``) names."""
+    try:
+        return _ALIASES[name.strip().lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown fabric {name!r}; expected one of {sorted(_ALIASES)}"
+        ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class FabricConfig:
+    """Launch-time fabric tuning — the analog of :85-95's env assembly."""
+
+    fabric: Fabric
+    fusion_threshold_bytes: int
+
+    def env_exports(self) -> dict[str, str]:
+        """Env/registry entries (UCX_TLS / HCOLL / FI_PROVIDER analogs)."""
+        return {
+            "TPU_HC_BENCH_FABRIC": self.fabric.value,
+            "TPU_HC_BENCH_FUSION_THRESHOLD": str(self.fusion_threshold_bytes),
+        }
+
+    def summary(self) -> str:
+        if self.fabric.is_fast:
+            return (
+                f"fabric={self.fabric.value}: XLA collectives over "
+                f"ICI{'+DCN' if self.fabric is Fabric.DCN else ''}, "
+                f"fusion_threshold={self.fusion_threshold_bytes}B"
+            )
+        return "fabric=host: host-mediated allreduce (slow-path smoke test)"
+
+
+def host_allreduce(tree: Any, devices: list[jax.Device] | None = None) -> Any:
+    """The ``sock`` slow path: reduce per-device values through host memory.
+
+    Takes a pytree whose leaves are stacked per-device arrays (leading axis =
+    device), pulls them to host, averages with numpy, and returns replicated
+    host arrays.  Deliberately unoptimized — it exists to (a) smoke-test the
+    training loop without ICI collectives and (b) give the fabric A/B
+    comparison its slow arm, mirroring the reference's ib-vs-sock experiment
+    (README.md:70-73).
+    """
+    del devices
+
+    def _reduce(leaf):
+        host = np.asarray(jax.device_get(leaf))
+        return np.mean(host, axis=0)
+
+    return jax.tree.map(_reduce, tree)

@@ -1,0 +1,164 @@
+"""Device-topology discovery, worker-layout math, and mesh construction.
+
+The reference computes its process layout from CPU topology with ``lscpu``
+(``benchmark-scripts/run-tf-sing-ucx-openmpi.sh:37-38``) and pure shell
+arithmetic (``:40-50``)::
+
+    NUM_SOCKETS, CORES_PER_SOCKET   <- lscpu
+    WORKERS_PER_SOCKET == 0  =>  1 worker/node, all cores        (:40-46)
+    else                     =>  WORKERS_PER_NODE = W * NUM_SOCKETS
+                                 CORES_PER_WORKER = CORES_PER_SOCKET / W
+    INTRA_T = CORES_PER_WORKER / 2                                (:48-49)
+    TOTAL_WORKERS = NUM_NODES * WORKERS_PER_NODE                  (:50)
+
+then pins one MPI rank per worker with exclusive cores
+(``--map-by ppr:W:socket,pe=C``, ``:102``) — topology-aware data parallelism.
+
+The TPU-native translation (SURVEY.md §7 stage 1): a *worker* is a TPU chip,
+a *node* is a TPU-VM host, and placement is a ``jax.sharding.Mesh`` laid out
+so the data-parallel axis rides ICI within a host slice and DCN across
+slices.  ``workers_per_host`` keeps the reference's ``WORKERS_PER_SOCKET``
+contract: ``0`` means "use every local chip" (the whole-machine mode of
+``:40-46``), ``k`` means "use k chips per host".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+# Mesh axis names.  Only "data" is used for reference parity (the reference
+# is DP-only, SURVEY.md §2c); the others exist so the mesh abstraction does
+# not preclude tensor/pipeline/sequence sharding later.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """Resolved worker layout — the TPU analog of the reference's :40-50 math."""
+
+    num_hosts: int           # NUM_NODES analog (launcher arg 1)
+    chips_per_host: int      # discovered, analog of lscpu sockets*cores
+    workers_per_host: int    # resolved (0 -> chips_per_host)
+    total_workers: int       # TOTAL_WORKERS (:50) == DP degree
+
+    @property
+    def global_batch_size(self) -> int:
+        raise AttributeError("use global_batch(per_worker_batch)")
+
+    def global_batch(self, per_worker_batch: int) -> int:
+        """Reference semantics: --batch_size is *per worker* (README.md:70)."""
+        return per_worker_batch * self.total_workers
+
+    def summary_lines(self, fabric: str = "ici") -> list[str]:
+        """Resolved-layout banner, mirroring run-tf-sing-ucx-openmpi.sh:52-58."""
+        return [
+            f"num_hosts={self.num_hosts} chips_per_host={self.chips_per_host}",
+            f"workers_per_host={self.workers_per_host} "
+            f"total_workers={self.total_workers} fabric={fabric}",
+        ]
+
+
+def compute_layout(
+    num_hosts: int,
+    workers_per_host: int,
+    chips_per_host: int,
+) -> Layout:
+    """Pure layout math (testable without devices).
+
+    Mirrors run-tf-sing-ucx-openmpi.sh:40-50 with chips in place of cores:
+    ``workers_per_host == 0`` selects whole-host mode (all chips, one DP
+    group member per chip — on TPU every chip is always its own worker, so
+    whole-host mode means "all local chips participate").
+    """
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    if workers_per_host < 0:
+        raise ValueError(f"workers_per_host must be >= 0, got {workers_per_host}")
+    if chips_per_host < 1:
+        raise ValueError(f"chips_per_host must be >= 1, got {chips_per_host}")
+    resolved = chips_per_host if workers_per_host == 0 else workers_per_host
+    if resolved > chips_per_host:
+        raise ValueError(
+            f"workers_per_host={resolved} exceeds chips_per_host={chips_per_host}"
+        )
+    return Layout(
+        num_hosts=num_hosts,
+        chips_per_host=chips_per_host,
+        workers_per_host=resolved,
+        total_workers=num_hosts * resolved,
+    )
+
+
+def discover_layout(
+    num_hosts: int | None = None,
+    workers_per_host: int = 0,
+    devices: Sequence[jax.Device] | None = None,
+) -> Layout:
+    """Layout from live device topology (the lscpu replacement, :37-38)."""
+    devices = list(devices if devices is not None else jax.devices())
+    hosts = sorted({d.process_index for d in devices})
+    discovered_hosts = len(hosts)
+    chips_per_host = sum(1 for d in devices if d.process_index == hosts[0])
+    return compute_layout(
+        num_hosts=num_hosts if num_hosts is not None else discovered_hosts,
+        workers_per_host=workers_per_host,
+        chips_per_host=chips_per_host,
+    )
+
+
+def select_devices(
+    layout: Layout, devices: Sequence[jax.Device] | None = None
+) -> list[jax.Device]:
+    """Pick ``workers_per_host`` chips on each host, in stable id order.
+
+    The analog of the reference's exclusive-core rank pinning
+    (``--map-by ppr:W:socket,pe=C``, :102): a deterministic, contiguous
+    device selection so ICI neighbors stay adjacent in the mesh.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    by_host: dict[int, list[jax.Device]] = {}
+    for d in sorted(devices, key=lambda d: d.id):
+        by_host.setdefault(d.process_index, []).append(d)
+    hosts = sorted(by_host)[: layout.num_hosts]
+    picked: list[jax.Device] = []
+    for h in hosts:
+        local = by_host[h]
+        if len(local) < layout.workers_per_host:
+            raise ValueError(
+                f"host {h} has {len(local)} chips < "
+                f"workers_per_host={layout.workers_per_host}"
+            )
+        picked.extend(local[: layout.workers_per_host])
+    return picked
+
+
+def build_mesh(
+    layout: Layout,
+    devices: Sequence[jax.Device] | None = None,
+    model_parallel: int = 1,
+) -> Mesh:
+    """Build the device mesh for this layout.
+
+    DP-only (reference parity) gives a 1-D ``("data",)`` mesh.  Passing
+    ``model_parallel > 1`` folds the trailing chips of each host into a
+    ``("data", "model")`` mesh so the same builder serves hybrid sharding
+    later without changing callers (SURVEY.md §2c implication).
+
+    Device order: host-major, chip-minor — the data axis crosses hosts last,
+    so intra-host ICI carries the short allreduce hops and DCN only the
+    inter-host phase (the `ib` fast path of run-tf-sing-ucx-openmpi.sh:85-92
+    by construction).
+    """
+    import numpy as np
+
+    picked = select_devices(layout, devices)
+    n = len(picked)
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    arr = np.array(picked, dtype=object).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
