@@ -1,0 +1,111 @@
+"""Model-zoo tests: registry dispatch, output shapes, parameter counts.
+
+Parameter counts are checked against the published architecture figures
+(ResNet-50 25.6M, VGG-16 138.4M, Inception-v3 23.8M, BERT-base ~110M) —
+a strong structural check that the fresh implementations match the
+architectures tf_cnn_benchmarks drives.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hc_bench import models
+from tpu_hc_bench.models import bert
+
+
+def n_params(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def init_model(name, image=None, num_classes=1000):
+    model, spec = models.create_model(name, num_classes=num_classes)
+    if spec.is_text:
+        x = jnp.zeros((1, *spec.input_shape), jnp.int32)
+    else:
+        x = jnp.zeros((1, *spec.input_shape), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    return model, spec, variables, x
+
+
+def test_registry_lists_reference_models():
+    names = models.list_models()
+    # resnet50 pinned by the reference (:34); inception3/vgg16/bert from
+    # BASELINE.json configs; trivial from tf_cnn_benchmarks
+    for required in ("resnet50", "inception3", "vgg16", "bert_base", "trivial"):
+        assert required in names
+
+
+def test_aliases():
+    assert models.get_model_spec("bert").name == "bert_base"
+    assert models.get_model_spec("inception_v3").name == "inception3"
+    with pytest.raises(ValueError):
+        models.get_model_spec("alexnet9000")
+
+
+def test_trivial_forward():
+    model, spec, variables, x = init_model("trivial")
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+
+
+def test_resnet50_params_and_shape():
+    model, spec, variables, x = init_model("resnet50")
+    count = n_params(variables["params"])
+    assert abs(count - 25.6e6) / 25.6e6 < 0.01, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+    assert "batch_stats" in variables
+
+
+def test_resnet18_params():
+    _, _, variables, _ = init_model("resnet18")
+    count = n_params(variables["params"])
+    assert abs(count - 11.7e6) / 11.7e6 < 0.02, count
+
+
+def test_vgg16_params():
+    model, spec, variables, x = init_model("vgg16")
+    count = n_params(variables["params"])
+    assert abs(count - 138.4e6) / 138.4e6 < 0.01, count
+
+
+def test_inception3_params_and_shape():
+    model, spec, variables, x = init_model("inception3")
+    count = n_params(variables["params"])
+    # canonical inception_v3 (no aux head) is ~23.8M
+    assert abs(count - 23.8e6) / 23.8e6 < 0.03, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+
+
+def test_bert_base_params():
+    model = bert.BertMLM()
+    x = jnp.zeros((1, 128), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    count = n_params(variables["params"])
+    # BERT-base ~110M (embeddings+encoder+mlm head, tied projection)
+    assert 105e6 < count < 115e6, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 128, bert.BERT_BASE_VOCAB)
+
+
+def test_bert_tiny_forward_train_mode():
+    model = bert.bert_tiny_mlm()
+    x = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(
+        variables, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)}
+    )
+    assert out.shape == (2, 16, 1024)
+
+
+def test_bf16_compute_keeps_fp32_params_and_logits():
+    model, spec = models.create_model("resnet18", dtype=jnp.bfloat16)
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    for leaf in jax.tree.leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32
+    out = model.apply(variables, x, train=False)
+    assert out.dtype == jnp.float32

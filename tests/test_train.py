@@ -1,0 +1,160 @@
+"""Train-step + driver tests on the 8-device virtual mesh.
+
+Uses small models/batches (CPU mesh) but exercises the full protocol:
+DP psum path, GSPMD replicated path, host (sock-analog) path, BN-stat sync,
+forward_only, and the driver's warmup/timed/display loop.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hc_bench import flags
+from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
+from tpu_hc_bench.models import ModelSpec, TrivialModel, create_model
+from tpu_hc_bench.parallel import fabric as fabric_mod
+from tpu_hc_bench.topology import compute_layout
+from tpu_hc_bench.train import driver, step as step_mod
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        batch_size=2, num_warmup_batches=1, num_batches=4, display_every=2,
+        model="trivial", num_classes=10, init_learning_rate=0.05,
+    )
+    base.update(kw)
+    return flags.BenchmarkConfig(**base).resolve()
+
+
+def tiny_image_setup(mesh8, cfg, shape=(8, 8, 3)):
+    spec = ModelSpec("trivial", TrivialModel, shape, 1e6)
+    model = TrivialModel(num_classes=cfg.num_classes)
+    ds = SyntheticImages(16, shape, num_classes=cfg.num_classes)
+    batch = ds.batch()
+    state = step_mod.make_train_state(model, cfg, batch)
+    state = step_mod.replicate_state(state, mesh8)
+    dev_batch = step_mod.shard_batch(batch, mesh8)
+    return model, spec, state, batch, dev_batch
+
+
+def run_steps(step_fn, state, batch, n=3):
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(n):
+        state, metrics = step_fn(state, batch, rng)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return state, losses
+
+
+def test_psum_path_loss_decreases(mesh8):
+    cfg = tiny_cfg()
+    model, spec, state, batch, dev_batch = tiny_image_setup(mesh8, cfg)
+    step_fn = step_mod.build_train_step(mesh8, cfg, spec)
+    state, losses = run_steps(step_fn, state, dev_batch, n=8)
+    assert losses[-1] < losses[0], losses
+
+
+def test_host_path_matches_ici_path(mesh8):
+    """The sock-analog slow path must produce the same update as ICI psum."""
+    cfg = tiny_cfg()
+    # two independent (deterministically identical) states: the ICI step
+    # donates its input buffers, so states can't be shared across paths
+    model, spec, state_a, batch, dev_batch = tiny_image_setup(mesh8, cfg)
+    _, _, state_b, _, _ = tiny_image_setup(mesh8, cfg)
+    ici = step_mod.build_train_step(mesh8, cfg, spec, fabric_mod.Fabric.ICI)
+    host = step_mod.build_train_step(mesh8, cfg, spec, fabric_mod.Fabric.HOST)
+    rng = jax.random.PRNGKey(0)
+    s_ici, _ = ici(state_a, dev_batch, rng)
+    s_host, _ = host(state_b, dev_batch, rng)
+    for a, b in zip(
+        jax.tree.leaves(s_ici.params), jax.tree.leaves(s_host.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+        )
+
+
+def test_resnet18_small_images_bn_sync(mesh8):
+    """BN model: batch_stats stay replicated-identical after the step."""
+    cfg = tiny_cfg(model="resnet18", num_classes=10, batch_size=1)
+    model, spec = create_model("resnet18", num_classes=10)
+    spec = ModelSpec("resnet18", None, (32, 32, 3), 1e8)
+    ds = SyntheticImages(8, (32, 32, 3), num_classes=10)
+    batch = ds.batch()
+    state = step_mod.make_train_state(model, cfg, batch)
+    state = step_mod.replicate_state(state, mesh8)
+    dev_batch = step_mod.shard_batch(batch, mesh8)
+    step_fn = step_mod.build_train_step(mesh8, cfg, spec)
+    state, losses = run_steps(step_fn, state, dev_batch, n=2)
+    assert state.batch_stats, "resnet must carry batch_stats"
+    assert np.isfinite(losses).all()
+
+
+def test_forward_only(mesh8):
+    cfg = tiny_cfg(forward_only=True)
+    model, spec, state, batch, dev_batch = tiny_image_setup(mesh8, cfg)
+    # snapshot params to host before the (donating) step invalidates buffers
+    orig = jax.device_get(state.params)
+    step_fn = step_mod.build_train_step(mesh8, cfg, spec)
+    s1, losses = run_steps(step_fn, state, dev_batch, n=3)
+    # params unchanged in forward_only mode
+    for a, b in zip(jax.tree.leaves(orig), jax.tree.leaves(s1.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert losses[0] == pytest.approx(losses[-1])
+
+
+def test_bert_tiny_mlm_step(mesh8):
+    from tpu_hc_bench.models import bert
+
+    cfg = tiny_cfg(model="bert_base", optimizer="adam",
+                   init_learning_rate=1e-3)
+    model = bert.bert_tiny_mlm()
+    spec = ModelSpec("bert_tiny", None, (16,), 1e6, is_text=True)
+    ds = SyntheticTokens(16, 16, vocab_size=1024)
+    batch = ds.batch()
+    state = step_mod.make_train_state(model, cfg, batch)
+    state = step_mod.replicate_state(state, mesh8)
+    dev_batch = step_mod.shard_batch(batch, mesh8)
+    step_fn = step_mod.build_train_step(mesh8, cfg, spec)
+    state, losses = run_steps(step_fn, state, dev_batch, n=6)
+    assert losses[-1] < losses[0], losses
+
+
+def test_driver_end_to_end(mesh8):
+    cfg = tiny_cfg(model="trivial", num_classes=100)
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    text = "\n".join(out)
+    assert "total images/sec:" in text
+    assert "warmup done" in text
+    assert res.total_images_per_sec > 0
+    assert res.total_workers == 8
+    assert res.global_batch == 16
+    assert np.isfinite(res.final_loss)
+
+
+def test_driver_host_fabric(mesh8):
+    cfg = tiny_cfg(model="trivial", num_classes=100, num_batches=2)
+    out = []
+    res = driver.run_benchmark(cfg, fabric_name="sock", print_fn=out.append)
+    assert res.fabric == "host"
+    assert res.total_images_per_sec > 0
+
+
+def test_log_name_convention():
+    # reference: tfmn-<n>n-<b>b-<data>-<fabric>-r<run>.log (:9-12)
+    assert driver.log_name(4, 64, "synthetic", "ici", 1) == \
+        "tpubench-4n-64b-synthetic-ici-r1.log"
+
+
+def test_launcher_positional_parse():
+    from tpu_hc_bench import launcher
+
+    pos, rest = launcher.parse_positionals(
+        ["4", "1", "64", "ib", "--model", "resnet50"]
+    )
+    assert pos == ["4", "1", "64", "ib"]
+    assert rest == ["--model", "resnet50"]
+    pos, rest = launcher.parse_positionals(["--model", "vgg16"])
+    assert pos == [] and rest == ["--model", "vgg16"]

@@ -1,0 +1,3 @@
+from tpu_hc_bench.launcher import main
+
+raise SystemExit(main())

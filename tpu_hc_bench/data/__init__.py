@@ -1,0 +1,10 @@
+"""Input pipelines: synthetic (default) and ImageNet TFRecords.
+
+Reference contract: tf_cnn_benchmarks runs synthetic data unless
+``--data_dir`` points at ImageNet TFRecords (the 20-of-1024-shard subset at
+``run-tf-sing-ucx-openmpi.sh:19,80-81``); each Horovod rank reads its own
+shard of the input.  Same here: ``make_input_fn`` returns a per-host
+iterator yielding globally-batched arrays laid out for the data mesh axis.
+"""
+
+from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens  # noqa: F401

@@ -1,0 +1,73 @@
+"""Synthetic inputs — tf_cnn_benchmarks' default data mode.
+
+tf_cnn_benchmarks with no ``--data_dir`` trains on fixed random tensors
+generated once and fed every step, making input cost ~zero so the benchmark
+measures compute + allreduce only.  Reproduced here: one deterministic
+random global batch, generated on host, reused for every step.  The driver
+device_puts it once with the data-axis sharding, so steady-state steps do no
+host->device transfer at all (stricter than the reference, which still runs
+its input pipeline graph ops on synthetic data).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SyntheticImages:
+    """Fixed random image batch: NHWC float32 images + int labels."""
+
+    global_batch: int
+    image_shape: tuple[int, int, int]  # (H, W, C)
+    num_classes: int = 1000
+    seed: int = 0
+
+    def batch(self) -> tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        images = rng.standard_normal(
+            (self.global_batch, *self.image_shape), dtype=np.float32
+        )
+        labels = rng.integers(
+            0, self.num_classes, size=(self.global_batch,), dtype=np.int32
+        )
+        return images, labels
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        batch = self.batch()
+        while True:
+            yield batch
+
+
+@dataclasses.dataclass
+class SyntheticTokens:
+    """Fixed random token batch for MLM: ids, targets, mask weights.
+
+    15% of positions are selected as prediction targets (BERT's masking
+    rate); selected input positions carry the [MASK]-style corruption (id 0).
+    """
+
+    global_batch: int
+    seq_len: int
+    vocab_size: int = 30522
+    mask_rate: float = 0.15
+    seed: int = 0
+
+    def batch(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        targets = rng.integers(
+            1, self.vocab_size, size=(self.global_batch, self.seq_len),
+            dtype=np.int32,
+        )
+        mask = rng.random((self.global_batch, self.seq_len)) < self.mask_rate
+        inputs = np.where(mask, 0, targets).astype(np.int32)
+        weights = mask.astype(np.float32)
+        return inputs, targets, weights
+
+    def __iter__(self):
+        batch = self.batch()
+        while True:
+            yield batch

@@ -78,6 +78,9 @@ class BenchmarkConfig:
     display_every: int = DEFAULT_DISPLAY_EVERY
     optimizer: str = "momentum"               # --optimizer=momentum (:74)
     forward_only: bool = False                # --forward_only=False (:75)
+    init_learning_rate: float = 0.01          # tf_cnn_benchmarks flag; the
+                                              # reference leaves the default
+    momentum: float = 0.9                     # tf_cnn_benchmarks default
 
     # --- data (reference :80-81) ---
     data_dir: str | None = None               # None => synthetic data
@@ -180,6 +183,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", type=str, default=d.optimizer,
                    choices=["momentum", "sgd", "adam", "adamw", "rmsprop"])
     p.add_argument("--forward_only", type=_parse_bool, default=d.forward_only)
+    p.add_argument("--init_learning_rate", type=float, default=d.init_learning_rate)
+    p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--data_dir", type=str, default=None)
     p.add_argument("--data_name", type=str, default=d.data_name)
     p.add_argument("--data_format", type=str, default="NHWC",

@@ -1,0 +1,90 @@
+"""Model zoo + registry — the tf_cnn_benchmarks ``--model=`` dispatch.
+
+The reference drives tf_cnn_benchmarks' model zoo through a single
+``--model`` flag (pinned to resnet50 at ``run-tf-sing-ucx-openmpi.sh:34,66``;
+BASELINE.json additionally names inception3, vgg16, and BERT-base MLM).
+This registry reproduces that dispatch for the TPU-native zoo, including
+tf_cnn_benchmarks' ``trivial`` model (flatten + one dense layer) used as a
+pipeline smoke test.
+
+``flops_per_example`` is the *forward-pass* FLOP count at the canonical
+input shape, used for MFU accounting (train step ~= 3x forward).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TrivialModel(nn.Module):
+    """tf_cnn_benchmarks' `trivial`: flatten -> dense(num_classes)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    create: Callable[..., nn.Module]   # (num_classes, dtype) -> Module
+    input_shape: tuple[int, ...]       # per-example, NHWC for images;
+                                       # (seq_len,) token ids for text
+    flops_per_example: float           # forward FLOPs at input_shape
+    is_text: bool = False
+    default_image_size: int = 224
+
+
+def _registry() -> dict[str, ModelSpec]:
+    from tpu_hc_bench.models import resnet, vgg, inception, bert
+
+    specs = [
+        ModelSpec("trivial", TrivialModel, (224, 224, 3), 2 * 150528 * 1000),
+        # ResNet fwd GFLOPs at 224^2 (2*MACs): v1.5 figures
+        ModelSpec("resnet18", resnet.resnet18, (224, 224, 3), 3.64e9),
+        ModelSpec("resnet34", resnet.resnet34, (224, 224, 3), 7.34e9),
+        ModelSpec("resnet50", resnet.resnet50, (224, 224, 3), 8.2e9),
+        ModelSpec("resnet101", resnet.resnet101, (224, 224, 3), 15.7e9),
+        ModelSpec("resnet152", resnet.resnet152, (224, 224, 3), 23.1e9),
+        ModelSpec("vgg16", vgg.vgg16, (224, 224, 3), 30.9e9),
+        ModelSpec("vgg19", vgg.vgg19, (224, 224, 3), 39.3e9),
+        ModelSpec("inception3", inception.inception_v3, (299, 299, 3), 11.4e9,
+                  default_image_size=299),
+        ModelSpec("bert_base", bert.bert_base_mlm, (128,), 2 * 110e6 * 128,
+                  is_text=True),
+    ]
+    return {s.name: s for s in specs}
+
+
+_ALIASES = {
+    "resnet50_v1.5": "resnet50",
+    "inception_v3": "inception3",
+    "bert": "bert_base",
+    "bert-base": "bert_base",
+}
+
+
+def get_model_spec(name: str) -> ModelSpec:
+    reg = _registry()
+    key = _ALIASES.get(name.lower(), name.lower())
+    if key not in reg:
+        raise ValueError(f"unknown model {name!r}; have {sorted(reg)}")
+    return reg[key]
+
+
+def list_models() -> list[str]:
+    return sorted(_registry())
+
+
+def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32):
+    spec = get_model_spec(name)
+    return spec.create(num_classes=num_classes, dtype=dtype), spec

@@ -1,0 +1,97 @@
+"""BERT-base masked-LM in Flax (BASELINE.json config 4: "BERT-base MLM
+pretraining (Horovod -> JAX GSPMD data-parallel)").
+
+Fresh TPU-first encoder: pre-computed position/segment embeddings, 12
+post-LN transformer layers (BERT-base: hidden 768, 12 heads, FFN 3072,
+vocab 30522), and an MLM head with tied input embeddings.  Attention and
+FFN matmuls are MXU-shaped; the whole step jits under the same DP mesh as
+the CNN zoo.  ``__call__`` takes token ids and returns per-position vocab
+logits; masking/weighting lives in the loss (train.step.mlm_loss).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BERT_BASE_VOCAB = 30522
+BERT_BASE_HIDDEN = 768
+BERT_BASE_LAYERS = 12
+BERT_BASE_HEADS = 12
+BERT_BASE_FFN = 3072
+BERT_MAX_LEN = 512
+
+
+class TransformerLayer(nn.Module):
+    hidden: int
+    heads: int
+    ffn: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = True):
+        # post-LN (original BERT): sublayer -> dropout -> add -> LN
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads,
+            qkv_features=self.hidden,
+            dtype=self.dtype,
+            deterministic=not train,
+            dropout_rate=0.1,
+        )(x, x, mask=mask)
+        attn = nn.Dropout(0.1, deterministic=not train)(attn)
+        x = nn.LayerNorm(dtype=self.dtype)(x + attn)
+        y = nn.Dense(self.ffn, dtype=self.dtype)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden, dtype=self.dtype)(y)
+        y = nn.Dropout(0.1, deterministic=not train)(y)
+        return nn.LayerNorm(dtype=self.dtype)(x + y)
+
+
+class BertMLM(nn.Module):
+    vocab_size: int = BERT_BASE_VOCAB
+    hidden: int = BERT_BASE_HIDDEN
+    num_layers: int = BERT_BASE_LAYERS
+    heads: int = BERT_BASE_HEADS
+    ffn: int = BERT_BASE_FFN
+    max_len: int = BERT_MAX_LEN
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = True):
+        b, s = token_ids.shape
+        embed = nn.Embed(
+            self.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
+        )
+        x = embed(token_ids)
+        pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
+                       name="pos_embed")(jnp.arange(s)[None, :])
+        x = nn.LayerNorm(dtype=self.dtype)(x + pos)
+        x = nn.Dropout(0.1, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            x = TransformerLayer(
+                self.hidden, self.heads, self.ffn, dtype=self.dtype,
+                name=f"layer_{i}",
+            )(x, train=train)
+        # MLM head: dense+gelu+LN, then tied-embedding projection
+        x = nn.Dense(self.hidden, dtype=self.dtype, name="mlm_dense")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="mlm_ln")(x)
+        logits = embed.attend(x.astype(jnp.float32))
+        bias = self.param("mlm_bias", nn.initializers.zeros, (self.vocab_size,))
+        return logits + bias
+
+
+def bert_base_mlm(num_classes: int = 0, dtype=jnp.float32):
+    """Registry adapter; num_classes is ignored (vocab is the label space)."""
+    del num_classes
+    return BertMLM(dtype=dtype)
+
+
+def bert_tiny_mlm(dtype=jnp.float32):
+    """4-layer/128-hidden variant for tests and CPU smoke runs."""
+    return BertMLM(
+        vocab_size=1024, hidden=128, num_layers=4, heads=4, ffn=512,
+        max_len=128, dtype=dtype,
+    )

@@ -1,0 +1,144 @@
+"""ResNet family in Flax — the reference's flagship workload.
+
+The reference pins ``MODEL=resnet50`` (``run-tf-sing-ucx-openmpi.sh:34``)
+and drives tf_cnn_benchmarks' ResNet-50 v1.5 implementation (the variant
+with stride 2 on the 3x3 conv of the downsampling bottleneck) on 224x224
+ImageNet in NCHW for MKL-DNN.  This is a fresh TPU-first implementation:
+
+- NHWC only: channels on the 128-lane minor axis is what the MXU tiles
+  (the launcher's ``--data_format=NCHW`` is translated by flags.resolve).
+- Parameterized compute dtype: fp32 for reference parity, bf16 for the TPU
+  fast path; parameters and BN statistics stay fp32 either way.
+- BatchNorm uses *local* batch statistics per data-parallel worker, which is
+  exactly Horovod DP semantics (each rank normalizes over its own
+  per-worker batch; only gradients are allreduced).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """ResNet-v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1, projection shortcut."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        # v1.5: stride lives on the 3x3, not the 1x1
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="shortcut_conv",
+            )(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return self.act(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """Two-3x3 block for ResNet-18/34."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides),
+                name="shortcut_conv",
+            )(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ImageNet ResNet, NHWC, parameterized depth and dtype."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), strides=(2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=act,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool over H,W
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(num_classes=1000, dtype=jnp.float32):
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes, dtype=dtype)
+
+
+def resnet34(num_classes=1000, dtype=jnp.float32):
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes=num_classes, dtype=dtype)
+
+
+def resnet50(num_classes=1000, dtype=jnp.float32):
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
+
+
+def resnet101(num_classes=1000, dtype=jnp.float32):
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
+
+
+def resnet152(num_classes=1000, dtype=jnp.float32):
+    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)

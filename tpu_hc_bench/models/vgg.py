@@ -1,0 +1,47 @@
+"""VGG-16/19 in Flax (BASELINE.json config 3: "Inception-v3 / VGG-16 sweep").
+
+Classic VGG (Simonyan & Zisserman) as driven by tf_cnn_benchmarks: conv
+stacks without batch norm, two 4096-unit FC layers, NHWC.  Fresh TPU-first
+implementation — the big FC layers are exactly MXU-shaped matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGG(nn.Module):
+    stage_sizes: Sequence[int]          # convs per stage, 5 stages
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        filters = (64, 128, 256, 512, 512)
+        for stage, n_convs in enumerate(self.stage_sizes):
+            for i in range(n_convs):
+                x = nn.Conv(
+                    filters[stage], (3, 3), padding="SAME", dtype=self.dtype,
+                    name=f"conv{stage + 1}_{i + 1}",
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc6")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc7")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc8")(x)
+        return x.astype(jnp.float32)
+
+
+def vgg16(num_classes=1000, dtype=jnp.float32):
+    return VGG([2, 2, 3, 3, 3], num_classes=num_classes, dtype=dtype)
+
+
+def vgg19(num_classes=1000, dtype=jnp.float32):
+    return VGG([2, 2, 4, 4, 4], num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,5 @@
+"""Training: the TPU-native replacement for tf_cnn_benchmarks' train loop +
+Horovod DistributedOptimizer (SURVEY.md §3.1 per-step hot loop)."""
+
+from tpu_hc_bench.train.step import TrainState, make_train_state, build_train_step  # noqa: F401
+from tpu_hc_bench.train.driver import run_benchmark, BenchmarkResult, log_name  # noqa: F401

@@ -1,0 +1,168 @@
+"""The benchmark driver: tf_cnn_benchmarks' measurement protocol on TPU.
+
+Reproduces the reference's experiment shape exactly
+(``run-tf-sing-ucx-openmpi.sh:32-35,71``): ``num_warmup_batches`` untimed
+steps (covering compile — the analog of the reference's warmup absorbing
+graph build + MKL priming), then ``num_batches`` timed steps, throughput
+printed every ``display_every`` steps, and a final ``total images/sec``
+line — the metric the operator greps from the teed log (SURVEY.md §5
+observability row).  Adds what the reference lacks: per-chip throughput,
+step-time stats, and MFU against the chip's peak (BASELINE.md targets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Callable
+
+import jax
+import numpy as np
+
+from tpu_hc_bench.flags import BenchmarkConfig
+from tpu_hc_bench.models import create_model
+from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
+from tpu_hc_bench.parallel import fabric as fabric_mod
+from tpu_hc_bench.topology import Layout, build_mesh, discover_layout
+from tpu_hc_bench.train import step as step_mod
+from tpu_hc_bench.utils import hw
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    model: str
+    total_workers: int
+    global_batch: int
+    total_images_per_sec: float      # "total images/sec" (tf_cnn final line)
+    images_per_sec_per_chip: float
+    mean_step_ms: float
+    p50_step_ms: float
+    mfu: float
+    final_loss: float
+    fabric: str
+
+    def json_line(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def log_name(
+    num_hosts: int, batch: int, data: str, fabric: str, run: int = 1
+) -> str:
+    """Log naming convention, after the reference's
+    ``tfmn-<n>n-<b>b-<data>-<fabric>-r<run>.log`` (run-tf-sing-ucx-openmpi.sh:9-12)."""
+    return f"tpubench-{num_hosts}n-{batch}b-{data}-{fabric}-r{run}.log"
+
+
+def _example_units(cfg: BenchmarkConfig, spec) -> str:
+    return "examples" if spec.is_text else "images"
+
+
+def run_benchmark(
+    cfg: BenchmarkConfig,
+    layout: Layout | None = None,
+    fabric_name: str = "ici",
+    print_fn: Callable[[str], None] = print,
+    model_dtype=None,
+) -> BenchmarkResult:
+    """Run the full benchmark protocol; returns the measured result."""
+    import jax.numpy as jnp
+
+    fab = fabric_mod.resolve_fabric(fabric_name)
+    layout = layout or discover_layout()
+    mesh = build_mesh(layout)
+    global_batch = layout.global_batch(cfg.batch_size)
+
+    dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
+    model, spec = create_model(cfg.model, num_classes=cfg.num_classes, dtype=dtype)
+
+    # --- banner (reference :52-58 config echo) ---
+    for line in layout.summary_lines(fabric=fab.value):
+        print_fn(line)
+    for line in cfg.summary_lines():
+        print_fn(line)
+    fcfg = fabric_mod.FabricConfig(fab, cfg.fusion_threshold_bytes)
+    print_fn(fcfg.summary())
+    print_fn(f"device_kind={hw.device_kind()} global_batch={global_batch}")
+
+    # --- data ---
+    if spec.is_text:
+        seq_len = spec.input_shape[0]
+        ds = SyntheticTokens(global_batch, seq_len, seed=cfg.seed)
+    else:
+        ds = SyntheticImages(
+            global_batch, spec.input_shape, num_classes=cfg.num_classes,
+            seed=cfg.seed,
+        )
+    batch = ds.batch()
+
+    # --- state + step ---
+    state = step_mod.make_train_state(model, cfg, batch)
+    state = step_mod.replicate_state(state, mesh)
+    dev_batch = step_mod.shard_batch(batch, mesh)
+    train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
+    rng = jax.random.PRNGKey(cfg.seed + 17)
+
+    # --- warmup (includes compile; reference warmup=50, :32) ---
+    t_compile = time.perf_counter()
+    metrics = None
+    for _ in range(max(1, cfg.num_warmup_batches)):
+        state, metrics = train_step(state, dev_batch, rng)
+    jax.block_until_ready(state.params)
+    print_fn(
+        f"warmup done: {cfg.num_warmup_batches} steps in "
+        f"{time.perf_counter() - t_compile:.1f}s (includes compile)"
+    )
+
+    # --- timed loop (reference num_batches=100, display_every=10) ---
+    units = _example_units(cfg, spec)
+    step_times: list[float] = []
+    losses: list[float] = []
+    window_start = time.perf_counter()
+    for i in range(1, cfg.num_batches + 1):
+        t0 = time.perf_counter()
+        state, metrics = train_step(state, dev_batch, rng)
+        jax.block_until_ready(metrics["loss"])
+        step_times.append(time.perf_counter() - t0)
+        if i % cfg.display_every == 0 or i == cfg.num_batches:
+            now = time.perf_counter()
+            window_steps = (
+                cfg.display_every if i % cfg.display_every == 0
+                else i % cfg.display_every
+            )
+            rate = window_steps * global_batch / (now - window_start)
+            loss = float(jax.device_get(metrics["loss"]))
+            losses.append(loss)
+            print_fn(f"{i}\t{units}/sec: {rate:.1f}\tloss: {loss:.3f}")
+            window_start = now
+
+    total_time = sum(step_times)
+    total_rate = cfg.num_batches * global_batch / total_time
+    per_chip = total_rate / layout.total_workers
+    mean_ms = 1e3 * total_time / cfg.num_batches
+    p50_ms = 1e3 * statistics.median(step_times)
+
+    # MFU: fwd+bwd ~= 3x forward FLOPs; forward-only runs use 1x
+    flops_mult = 1.0 if cfg.forward_only else 3.0
+    peak = hw.peak_flops(dtype=cfg.compute_dtype)
+    mfu = (flops_mult * spec.flops_per_example * per_chip) / peak
+
+    result = BenchmarkResult(
+        model=cfg.model,
+        total_workers=layout.total_workers,
+        global_batch=global_batch,
+        total_images_per_sec=total_rate,
+        images_per_sec_per_chip=per_chip,
+        mean_step_ms=mean_ms,
+        p50_step_ms=p50_ms,
+        mfu=mfu,
+        final_loss=losses[-1] if losses else float("nan"),
+        fabric=fab.value,
+    )
+    print_fn("-" * 40)
+    print_fn(f"total {units}/sec: {total_rate:.2f}")
+    print_fn(
+        f"{units}/sec/chip: {per_chip:.2f}  step: {mean_ms:.2f}ms "
+        f"(p50 {p50_ms:.2f}ms)  MFU: {100 * mfu:.1f}%"
+    )
+    return result

@@ -1,0 +1,256 @@
+"""Train-step builders: data-parallel SGD with XLA-collective allreduce.
+
+The reference's per-step hot loop (SURVEY.md §3.1) is: forward/backward on
+MKL-DNN kernels -> Horovod DistributedOptimizer allreduce (C++ fusion
+buffer, 128 MiB) -> OpenMPI/HCOLL -> UCX -> IB verbs.  The TPU-native step
+compiles the whole thing into one XLA program: forward/backward on the MXU,
+gradient ``psum`` over the mesh's data axis (optionally through the
+Horovod-style fusion buckets of ``parallel.collectives``), optimizer update
+fused in.  Three variable-update modes mirror the reference's
+``--variable_update`` choices (flags.py):
+
+- ``psum`` (default; reference ``horovod``): ``jax.shard_map`` over the
+  mesh — replicated params, sharded batch, explicit fused gradient psum.
+- ``replicated``: GSPMD — params/batch get shardings, XLA inserts the
+  collectives itself (the idiomatic-JAX arm of the A/B).
+- fabric ``host`` (reference ``sock``): per-device grads are stacked to
+  host, averaged in numpy, update applied on host — the slow-fallback
+  smoke path.
+
+BatchNorm: per-worker batch statistics during the step (Horovod semantics),
+then cross-worker ``pmean`` of the updated running stats so the replicated
+state stays bitwise-identical on every device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_hc_bench.flags import BenchmarkConfig
+from tpu_hc_bench.models import ModelSpec
+from tpu_hc_bench.parallel.collectives import allreduce_gradients
+from tpu_hc_bench.parallel import fabric as fabric_mod
+from tpu_hc_bench.topology import DATA_AXIS
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any            # {} for models without BN
+    opt_state: Any
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+
+def make_optimizer(cfg: BenchmarkConfig) -> optax.GradientTransformation:
+    """--optimizer dispatch (reference pins momentum, :74)."""
+    lr = cfg.init_learning_rate
+    if cfg.optimizer == "momentum":
+        return optax.sgd(lr, momentum=cfg.momentum)
+    if cfg.optimizer == "sgd":
+        return optax.sgd(lr)
+    if cfg.optimizer == "adam":
+        return optax.adam(lr)
+    if cfg.optimizer == "adamw":
+        return optax.adamw(lr)
+    if cfg.optimizer == "rmsprop":
+        return optax.rmsprop(lr, decay=0.9, eps=1.0)  # tf_cnn rmsprop params
+    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+
+
+def make_train_state(
+    model, cfg: BenchmarkConfig, example_batch: tuple, rng: jax.Array | None = None
+) -> TrainState:
+    """Initialize params on host-side abstract init, then TrainState."""
+    rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
+    inputs = example_batch[0]
+    variables = model.init(
+        {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
+        jnp.asarray(inputs[:1]),
+        train=False,
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = make_optimizer(cfg)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        apply_fn=model.apply,
+        tx=tx,
+    )
+
+
+def _loss_and_updates(state: TrainState, params, batch, dropout_rng, is_text: bool):
+    """Forward + loss; returns (loss, new_batch_stats)."""
+    variables = {"params": params}
+    has_stats = bool(state.batch_stats)
+    if has_stats:
+        variables["batch_stats"] = state.batch_stats
+    rngs = {"dropout": dropout_rng}
+    inputs = batch[0]
+    if has_stats:
+        logits, updated = state.apply_fn(
+            variables, inputs, train=True, rngs=rngs, mutable=["batch_stats"]
+        )
+        new_stats = updated["batch_stats"]
+    else:
+        logits = state.apply_fn(variables, inputs, train=True, rngs=rngs)
+        new_stats = {}
+    if is_text:
+        _, targets, weights = batch
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        loss = (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    else:
+        _, labels = batch
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+    return loss, new_stats
+
+
+def build_train_step(
+    mesh: Mesh,
+    cfg: BenchmarkConfig,
+    spec: ModelSpec,
+    fab: fabric_mod.Fabric = fabric_mod.Fabric.ICI,
+):
+    """Return ``step(state, batch, rng) -> (state, metrics)`` for the fabric.
+
+    The returned callable takes host or device arrays whose leading dim is
+    the global batch; sharding/replication is handled inside.
+    """
+    is_text = spec.is_text
+    fuse = cfg.variable_update == "psum"
+
+    if fab is fabric_mod.Fabric.HOST:
+        return _build_host_step(mesh, cfg, is_text)
+
+    def device_step(state: TrainState, batch, dropout_rng):
+        # per-device: local shard of the batch, replicated state
+        dropout_rng = jax.random.fold_in(
+            dropout_rng, jax.lax.axis_index(DATA_AXIS)
+        )
+
+        def loss_fn(p):
+            return _loss_and_updates(state, p, batch, dropout_rng, is_text)
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = allreduce_gradients(
+            grads,
+            threshold_bytes=cfg.fusion_threshold_bytes,
+            fuse=fuse,
+        )
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        if new_stats:
+            # sync running stats so replicated state stays identical
+            new_stats = jax.tree.map(
+                lambda s: jax.lax.pmean(s, DATA_AXIS), new_stats
+            )
+        updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        return new_state, {"loss": loss}
+
+    if cfg.forward_only:
+        def fwd_only(state, batch, dropout_rng):
+            loss, _ = _loss_and_updates(
+                state, state.params, batch, dropout_rng, is_text
+            )
+            return state, {"loss": jax.lax.pmean(loss, DATA_AXIS)}
+        device_step = fwd_only
+
+    replicated = P()
+    sharded = P(DATA_AXIS)
+    shard_fn = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(replicated, sharded, replicated),
+        out_specs=(replicated, replicated),
+        check_vma=False,
+    )
+    jitted = jax.jit(shard_fn, donate_argnums=(0,))
+
+    def step(state, batch, rng):
+        return jitted(state, batch, rng)
+
+    return step
+
+
+def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
+    """The `sock` path: grads computed per device, reduced through the host.
+
+    Deliberately slow (device->host->device every step) but exercises the
+    identical forward/backward, so it both smoke-tests without collectives
+    and provides the slow arm of the fabric A/B (README.md:70-73).
+    """
+
+    def local_grads(state: TrainState, batch, dropout_rng):
+        dropout_rng = jax.random.fold_in(
+            dropout_rng, jax.lax.axis_index(DATA_AXIS)
+        )
+
+        def loss_fn(p):
+            return _loss_and_updates(state, p, batch, dropout_rng, is_text)
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        # add leading device axis so out_specs can concatenate
+        expand = lambda t: jax.tree.map(lambda x: x[None], t)
+        return expand(grads), loss[None], expand(new_stats)
+
+    grads_fn = jax.jit(jax.shard_map(
+        local_grads,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P()),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    ))
+
+    @jax.jit
+    def apply_update(state: TrainState, grads, new_stats):
+        updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
+        return state.replace(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+
+    def step(state, batch, rng):
+        stacked_grads, losses, stacked_stats = grads_fn(state, batch, rng)
+        grads = fabric_mod.host_allreduce(stacked_grads)
+        stats = fabric_mod.host_allreduce(stacked_stats)
+        state = apply_update(state, grads, stats)
+        return state, {"loss": jnp.asarray(np.mean(jax.device_get(losses)))}
+
+    return step
+
+
+def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place the state replicated over the mesh (params live on-device)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(state, sharding)
+
+
+def shard_batch(batch: tuple, mesh: Mesh) -> tuple:
+    """Place a global host batch sharded over the data axis."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

@@ -1,0 +1,1 @@
+"""Utilities: hardware peak tables, log naming, sanity reporting."""

@@ -1,0 +1,42 @@
+"""Per-chip peak-FLOPs table for MFU accounting.
+
+The reference never computes MFU (its metric is raw images/sec); the
+BASELINE.json north star for this repo is ">=60% MFU on v5e", so the driver
+needs peak numbers.  Figures are the public per-chip peak dense-matmul
+rates (bf16 / fp32-equivalent) for each TPU generation; CPU gets a nominal
+figure so MFU stays defined (if meaningless) on the test mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# (bf16_peak_flops, fp32_peak_flops) per chip
+_PEAKS: dict[str, tuple[float, float]] = {
+    "v5 lite": (394e12, 197e12),   # v5e: 394 TFLOPs int8/bf16-class MXU,
+                                   # 197 TFLOPs bf16 — use (197, 98) conservatively
+    "v5litepod": (197e12, 98e12),
+    "v5e": (197e12, 98e12),
+    "v5p": (459e12, 229e12),
+    "v4": (275e12, 137e12),
+    "v3": (123e12, 61e12),
+    "v2": (45e12, 22e12),
+    "v6": (918e12, 459e12),        # v6e (Trillium)
+    "cpu": (1e11, 5e10),           # nominal, test-mesh only
+}
+# v5e correction: bf16 peak is 197 TFLOPs/chip; keep the conservative row.
+_PEAKS["v5 lite"] = (197e12, 98e12)
+
+
+def peak_flops(device: jax.Device | None = None, dtype: str = "bfloat16") -> float:
+    """Best-effort peak FLOPs/s for one chip of this device kind."""
+    device = device or jax.devices()[0]
+    kind = device.device_kind.lower()
+    for key, (bf16, f32) in _PEAKS.items():
+        if key in kind:
+            return bf16 if dtype == "bfloat16" else f32
+    return _PEAKS["cpu"][0 if dtype == "bfloat16" else 1]
+
+
+def device_kind() -> str:
+    return jax.devices()[0].device_kind
