@@ -1,0 +1,61 @@
+"""Headline benchmark: ResNet-50 synthetic images/sec/chip on real TPU.
+
+Runs the reference measurement protocol (50 warmup + 100 timed batches,
+``run-tf-sing-ucx-openmpi.sh:32-35``) on ResNet-50 with synthetic data —
+the exact experiment of BASELINE.json config 1 — on every available chip,
+and prints ONE JSON line.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+comparison point is the widely reported tf_cnn_benchmarks ResNet-50 fp32
+MKL throughput of a 2-socket Xeon-Platinum HC-class node, ~85 images/sec
+per node — i.e. vs_baseline is images/sec-per-chip over images/sec-per-
+reference-node, worker-unit vs worker-unit.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+REFERENCE_NODE_IMAGES_PER_SEC = 85.0
+
+
+def main() -> int:
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.train import driver
+
+    cfg = flags.BenchmarkConfig(
+        batch_size=128,
+        model="resnet50",
+        use_fp16=True,          # bf16 compute: the TPU-native fast path
+        num_warmup_batches=50,
+        num_batches=100,
+        display_every=10,
+    ).resolve()
+
+    # human-readable progress to stderr; stdout carries only the JSON line
+    result = driver.run_benchmark(
+        cfg, fabric_name="ici",
+        print_fn=lambda m: print(m, file=sys.stderr, flush=True),
+    )
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(result.images_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            result.images_per_sec_per_chip / REFERENCE_NODE_IMAGES_PER_SEC, 3
+        ),
+        "extra": {
+            "total_images_per_sec": round(result.total_images_per_sec, 2),
+            "mfu": round(result.mfu, 4),
+            "chips": result.total_workers,
+            "global_batch": result.global_batch,
+            "mean_step_ms": round(result.mean_step_ms, 3),
+            "dtype": cfg.compute_dtype,
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

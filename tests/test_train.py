@@ -142,6 +142,25 @@ def test_driver_host_fabric(mesh8):
     assert res.total_images_per_sec > 0
 
 
+def test_driver_real_tfrecord_data(mesh8, tmp_path):
+    """End-to-end with the real-data path: TFRecord shards -> train loop."""
+    from tpu_hc_bench.data import imagenet
+
+    imagenet.make_synthetic_shards(
+        tmp_path, num_shards=2, examples_per_shard=16, image_size=32,
+        num_classes=100,
+    )
+    cfg = tiny_cfg(
+        model="trivial", num_classes=100, data_dir=str(tmp_path),
+        num_warmup_batches=1, num_batches=2,
+    )
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    assert res.total_images_per_sec > 0
+    assert np.isfinite(res.final_loss)
+    assert any("real" in l or str(tmp_path) in l for l in out)
+
+
 def test_log_name_convention():
     # reference: tfmn-<n>n-<b>b-<data>-<fabric>-r<run>.log (:9-12)
     assert driver.log_name(4, 64, "synthetic", "ici", 1) == \

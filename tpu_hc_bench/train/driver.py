@@ -86,20 +86,51 @@ def run_benchmark(
     print_fn(f"device_kind={hw.device_kind()} global_batch={global_batch}")
 
     # --- data ---
-    if spec.is_text:
+    if cfg.data_dir is not None and not spec.is_text:
+        # real ImageNet TFRecords, per-host shard split (reference :19,80-81)
+        from tpu_hc_bench.data.imagenet import ImageNetDataset
+
+        image_size = spec.default_image_size
+        ds = ImageNetDataset(
+            cfg.data_dir,
+            global_batch=global_batch,
+            image_size=image_size,
+            worker=jax.process_index(),
+            num_workers=jax.process_count(),
+            seed=cfg.seed,
+        )
+        host_iter = iter(ds)
+        batch = next(host_iter)
+
+        def batches():
+            yield step_mod.shard_batch(batch, mesh)
+            for b in host_iter:
+                yield step_mod.shard_batch(b, mesh)
+    elif spec.is_text:
         seq_len = spec.input_shape[0]
         ds = SyntheticTokens(global_batch, seq_len, seed=cfg.seed)
+        batch = ds.batch()
+
+        def batches():
+            dev_batch = step_mod.shard_batch(batch, mesh)
+            while True:
+                yield dev_batch
     else:
         ds = SyntheticImages(
             global_batch, spec.input_shape, num_classes=cfg.num_classes,
             seed=cfg.seed,
         )
-    batch = ds.batch()
+        batch = ds.batch()
+
+        def batches():
+            dev_batch = step_mod.shard_batch(batch, mesh)
+            while True:
+                yield dev_batch
 
     # --- state + step ---
     state = step_mod.make_train_state(model, cfg, batch)
     state = step_mod.replicate_state(state, mesh)
-    dev_batch = step_mod.shard_batch(batch, mesh)
+    batch_iter = batches()
     train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
     rng = jax.random.PRNGKey(cfg.seed + 17)
 
@@ -107,7 +138,7 @@ def run_benchmark(
     t_compile = time.perf_counter()
     metrics = None
     for _ in range(max(1, cfg.num_warmup_batches)):
-        state, metrics = train_step(state, dev_batch, rng)
+        state, metrics = train_step(state, next(batch_iter), rng)
     jax.block_until_ready(state.params)
     print_fn(
         f"warmup done: {cfg.num_warmup_batches} steps in "
@@ -121,7 +152,7 @@ def run_benchmark(
     window_start = time.perf_counter()
     for i in range(1, cfg.num_batches + 1):
         t0 = time.perf_counter()
-        state, metrics = train_step(state, dev_batch, rng)
+        state, metrics = train_step(state, next(batch_iter), rng)
         jax.block_until_ready(metrics["loss"])
         step_times.append(time.perf_counter() - t0)
         if i % cfg.display_every == 0 or i == cfg.num_batches:
