@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Provision a multi-host TPU pod slice — fills the role of the reference's
+# EMPTY azure-scripts/create-az-vmss-cluster.sh + manual README Step 4
+# (README.md:47): launch N nodes from one image.  On TPU the "image clone"
+# is the pod slice itself: every host gets the identical runtime, replacing
+# the reference's deprovision/generalize/image-create cycle
+# (README.md:32-45) entirely.
+#
+#   usage: ./create-tpu-pod.sh <name> [zone] [accelerator-type] [version]
+set -euo pipefail
+
+NAME="${1:?usage: $0 <name> [zone] [accelerator-type] [runtime-version]}"
+ZONE="${2:-us-central2-b}"
+ACCEL="${3:-v5litepod-32}"     # BASELINE north star: v5e-32
+VERSION="${4:-tpu-ubuntu2204-base}"
+
+command -v gcloud >/dev/null || { echo "gcloud CLI required" >&2; exit 1; }
+
+gcloud compute tpus tpu-vm create "$NAME" \
+    --zone="$ZONE" \
+    --accelerator-type="$ACCEL" \
+    --version="$VERSION"
+
+echo "pod created; prep all hosts with ./prep-cluster.sh $NAME $ZONE"

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Provision a single TPU-VM — fills the role of the reference's EMPTY
+# azure-scripts/create-az-vm.sh + manual README Step 1 (README.md:10):
+# the reference never automated node launch; this script does, for TPU.
+#
+#   usage: ./create-tpu-vm.sh <name> [zone] [accelerator-type] [version]
+set -euo pipefail
+
+NAME="${1:?usage: $0 <name> [zone] [accelerator-type] [runtime-version]}"
+ZONE="${2:-us-central2-b}"
+ACCEL="${3:-v5litepod-1}"
+VERSION="${4:-tpu-ubuntu2204-base}"
+
+command -v gcloud >/dev/null || { echo "gcloud CLI required" >&2; exit 1; }
+
+gcloud compute tpus tpu-vm create "$NAME" \
+    --zone="$ZONE" \
+    --accelerator-type="$ACCEL" \
+    --version="$VERSION"
+
+echo "created; set it up with:"
+echo "  gcloud compute tpus tpu-vm ssh $NAME --zone=$ZONE --command='git clone <this-repo> && cd tpu-hc-bench && ./scripts/setup/setup-tpu-vm.sh stable'"

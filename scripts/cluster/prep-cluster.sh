@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Cluster prep for a TPU pod — the counterpart of azure-scripts/
+# prep-cluster.sh + setup-pwdless-ssh.sh (README Step 5, README.md:50-60).
+#
+# The reference needed: nmap subnet sweep for discovery, sshpass all-to-all
+# key mesh, per-node IB port checks, IPoIB bring-up, and stopping the Azure
+# agent (prep-cluster.sh:20-29).  A TPU pod's control plane already
+# provides discovery and all-host SSH (`--worker=all`), and libtpu owns the
+# fabric, so prep reduces to: fan software out to every host, write the
+# nodeips.txt hostfile contract (setup-pwdless-ssh.sh:32), and run the
+# per-host fabric/stack sanity check (ibv_devinfo analog).
+#
+#   usage: ./prep-cluster.sh <pod-name> [zone] [repo-url]
+set -euo pipefail
+
+POD="${1:?usage: $0 <pod-name> [zone] [repo-url]}"
+ZONE="${2:-us-central2-b}"
+REPO="${3:-}"
+
+command -v gcloud >/dev/null || { echo "gcloud CLI required" >&2; exit 1; }
+
+# 1. discovery -> hostfile contract (~/nodeips.txt, consumed by launchers
+#    exactly as mpirun consumed it, run-tf-sing-ucx-openmpi.sh:25,101)
+gcloud compute tpus tpu-vm describe "$POD" --zone="$ZONE" \
+    --format='value(networkEndpoints[].ipAddress)' \
+    | tr ';' '\n' > "$HOME/nodeips.txt"
+N=$(wc -l < "$HOME/nodeips.txt")
+echo "discovered $N hosts -> ~/nodeips.txt"
+
+# 2. software fan-out (replaces the O(N^2) sshpass key mesh: pod SSH is
+#    already trusted)
+if [ -n "$REPO" ]; then
+    gcloud compute tpus tpu-vm ssh "$POD" --zone="$ZONE" --worker=all \
+        --command="git clone $REPO tpu-hc-bench 2>/dev/null || (cd tpu-hc-bench && git pull); cd tpu-hc-bench && ./scripts/setup/setup-tpu-vm.sh stable"
+fi
+
+# 3. per-host sanity: device visible + stack importable (the
+#    `pssh ibv_devinfo | grep state` analog, prep-cluster.sh:23)
+gcloud compute tpus tpu-vm ssh "$POD" --zone="$ZONE" --worker=all \
+    --command="python -m tpu_hc_bench.utils.sanity"
+
+echo "cluster ready: run benchmarks with scripts/run-tpu-ici.sh via --worker=all"

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Benchmark launcher, DCN / slow-path variant — the counterpart of
+# benchmark-scripts/run-tf-sing-libfabric-intelmpi.sh (the reference's
+# second, interchangeable comm stack; same semantics, different plumbing,
+# SURVEY.md §3.2).  On TPU the "second stack" is the cross-slice DCN path
+# (fabric=dcn) or the host-mediated slow path (fabric=host / sock).
+set -euo pipefail
+
+if [ "$#" -ne 4 ]; then
+    echo "usage: $0 <NUM_HOSTS> <WORKERS_PER_HOST(0=all chips)> <batch_size> <fabric(dcn|host|sock)>"
+    exit 1
+fi
+
+FABRIC=$4
+case "$FABRIC" in
+    ici|ib)
+        echo "note: $0 is the DCN/slow-path launcher; use run-tpu-ici.sh for fabric=$FABRIC" >&2
+        ;;
+esac
+
+SETENV="${TPU_HC_BENCH_SETENV:-$HOME/.tpu_hc_bench/setenv}"
+[ -f "$SETENV" ] && . "$SETENV"
+
+MODEL="${MODEL:-resnet50}"
+NUM_WARMUP="${NUM_WARMUP:-50}"
+NUM_BATCHES="${NUM_BATCHES:-100}"
+DATA_DIR_ARGS=()
+[ -n "${DATA_DIR:-}" ] && DATA_DIR_ARGS=(--data_dir "$DATA_DIR")
+
+mkdir -p "$HOME/logs"
+
+exec python -m tpu_hc_bench \
+    "$1" "$2" "$3" "$FABRIC" \
+    --model "$MODEL" \
+    --num_warmup_batches "$NUM_WARMUP" \
+    --num_batches "$NUM_BATCHES" \
+    --optimizer momentum \
+    --display_every 10 \
+    "${DATA_DIR_ARGS[@]}" \
+    "${EXTRA_ARGS[@]:-}"

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Benchmark launcher, ICI fast path — the TPU-native counterpart of
+# benchmark-scripts/run-tf-sing-ucx-openmpi.sh (same 4-arg signature,
+# reference :4,27-30):
+#
+#   ./run-tpu-ici.sh <NUM_HOSTS> <WORKERS_PER_HOST> <batch_size> <fabric(ici,host)>
+#
+# Reference ib|sock names are accepted for the 4th arg.  Where the
+# reference's mpirun fans ranks out over ~/nodeips.txt via the pwdless-SSH
+# mesh (:99-109), a TPU pod runs this same script on every host (e.g. via
+# `gcloud compute tpus tpu-vm ssh --worker=all --command=...`) and
+# jax.distributed coordinates; on a single host it just runs.
+set -euo pipefail
+
+if [ "$#" -ne 4 ]; then
+    echo "usage: $0 <NUM_HOSTS> <WORKERS_PER_HOST(0=all chips)> <batch_size> <fabric(ici|host|ib|sock)>"
+    exit 1
+fi
+
+NUM_HOSTS=$1
+WORKERS_PER_HOST=$2
+BATCH_SIZE=$3
+FABRIC=$4
+
+# env registry, the setenv contract (reference sources /mnt/shared/setenv :14)
+SETENV="${TPU_HC_BENCH_SETENV:-$HOME/.tpu_hc_bench/setenv}"
+[ -f "$SETENV" ] && . "$SETENV"
+
+# experiment constants mirroring the reference launcher (:32-35)
+MODEL="${MODEL:-resnet50}"
+NUM_WARMUP="${NUM_WARMUP:-50}"
+NUM_BATCHES="${NUM_BATCHES:-100}"
+DATA_DIR_ARGS=()
+[ -n "${DATA_DIR:-}" ] && DATA_DIR_ARGS=(--data_dir "$DATA_DIR")
+
+mkdir -p "$HOME/logs"
+
+exec python -m tpu_hc_bench \
+    "$NUM_HOSTS" "$WORKERS_PER_HOST" "$BATCH_SIZE" "$FABRIC" \
+    --model "$MODEL" \
+    --num_warmup_batches "$NUM_WARMUP" \
+    --num_batches "$NUM_BATCHES" \
+    --optimizer momentum \
+    --display_every 10 \
+    "${DATA_DIR_ARGS[@]}" \
+    "${EXTRA_ARGS[@]:-}"

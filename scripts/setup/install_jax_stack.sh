@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Install the pinned JAX/TPU software stack — the counterpart of the
+# reference's from-source toolchain builds (install_gcc-8.2.sh,
+# install_ucx_ompi.sh, install_conda_tf_hvd.sh).  Pinned-version ethos
+# preserved: a known-good version set, installed idempotently.  On images
+# where the stack is already baked (this repo's CI container, Cloud TPU-VM
+# base images), detection short-circuits to a no-op.
+#
+#   usage: ./install_jax_stack.sh <stable|nightly>
+set -euo pipefail
+
+CHANNEL="${1:-stable}"
+PIN_JAX="0.9.0"   # known-good pin, the UCX-1.5.0-style version lock
+
+if python - <<'EOF'
+import sys
+try:
+    import jax, flax, optax  # noqa
+except Exception:
+    sys.exit(1)
+sys.exit(0)
+EOF
+then
+    echo "jax stack already present: $(python -c 'import jax; print(jax.__version__)') — skipping install"
+    exit 0
+fi
+
+if ! command -v pip >/dev/null; then
+    echo "pip unavailable and jax missing; cannot install" >&2
+    exit 1
+fi
+
+case "$CHANNEL" in
+    stable)
+        pip install "jax[tpu]==${PIN_JAX}" flax optax chex einops \
+            -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+        ;;
+    nightly)
+        pip install --pre -U jax[tpu] flax optax chex einops \
+            -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+        ;;
+esac

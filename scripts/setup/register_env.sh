@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Append this install's exports to the env registry — the setenv role
+# (reference: every installer appends to /mnt/shared/setenv,
+# install_gcc-8.2.sh:34-41).  Uses the idempotent python registry so
+# re-running replaces rather than duplicates.
+set -euo pipefail
+
+python - <<'EOF'
+from tpu_hc_bench import envfile
+import sys, pathlib
+
+repo = str(pathlib.Path(__file__ if "__file__" in dir() else ".").resolve())
+path = envfile.register("stack", {
+    "TPU_HC_BENCH_PYTHON": sys.executable,
+    # jit-cache directory: makes recompiles across runs warm, the analog of
+    # the reference's one-time 80-minute build amortization
+    "JAX_COMPILATION_CACHE_DIR": str(pathlib.Path.home() / ".tpu_hc_bench" / "jit-cache"),
+})
+print(f"env registry updated: {path}")
+EOF

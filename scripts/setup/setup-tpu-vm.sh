@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Top-level TPU-VM setup — the counterpart of
+# 2-setup-host-and-build-container.sh (reference :6-26): one command that
+# prepares a freshly created TPU-VM to run benchmarks.  Where the
+# reference's ~80-minute build compiles GCC twice and bakes a Singularity
+# image, the TPU-VM path is minutes: install the pinned JAX stack (libtpu
+# ships with the TPU-VM image, playing OFED's role — SURVEY.md §2b #24),
+# tune the OS, register the env, and run the sanity report (the
+# `singularity run` equivalent, build-container.sh:29-30).
+#
+#   usage: ./setup-tpu-vm.sh <stable|nightly>     (reference: <intelmpi|openmpi>)
+set -euo pipefail
+
+CHANNEL="${1:-stable}"
+HERE="$(cd "$(dirname "$0")" && pwd)"
+
+case "$CHANNEL" in
+    stable|nightly) ;;
+    *) echo "usage: $0 <stable|nightly>"; exit 1 ;;
+esac
+
+"$HERE/update_config.sh"
+"$HERE/install_jax_stack.sh" "$CHANNEL"
+"$HERE/register_env.sh"
+
+# sanity report gates success, as singularity run gates the container build
+python -m tpu_hc_bench.utils.sanity
+echo "setup complete; source \${TPU_HC_BENCH_SETENV:-\$HOME/.tpu_hc_bench/setenv} before running benchmarks"

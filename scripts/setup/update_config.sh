@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# OS tuning for benchmark hosts — the counterpart of
+# install-scripts/update_config.sh (memlock/nofile limits :6-11,
+# zone_reclaim :18-23, firewalld stop :26).  TPU-VMs need far less: raise
+# fd limits for sharded input pipelines and disable transparent hugepage
+# defrag stalls.  Every change is skipped gracefully without root.
+set -uo pipefail
+
+if [ "$(id -u)" -eq 0 ] && [ -d /etc/security ]; then
+    if ! grep -q tpu_hc_bench /etc/security/limits.conf 2>/dev/null; then
+        cat >> /etc/security/limits.conf <<'EOF'
+# tpu_hc_bench: fd limits for sharded TFRecord input pipelines
+* soft nofile 65535
+* hard nofile 65535
+EOF
+        echo "limits.conf: nofile raised to 65535"
+    fi
+    if [ -w /sys/kernel/mm/transparent_hugepage/defrag ]; then
+        echo madvise > /sys/kernel/mm/transparent_hugepage/defrag || true
+        echo "transparent_hugepage defrag -> madvise"
+    fi
+else
+    echo "update_config: not root, skipping OS tuning (non-fatal)"
+fi
+exit 0

@@ -1,0 +1,109 @@
+"""ctypes bindings for the native TFRecord scanner (tfrecord_reader.cpp).
+
+Builds the shared library on first use if g++ is available (a one-second
+build — the reference spent ~80 minutes building its native stack,
+README.md:23-24); falls back cleanly to the pure-Python codec otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).parent
+_LIB_PATH = _DIR / "libthb_tfrecord.so"
+_lib = None
+_build_failed = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    if not _LIB_PATH.exists():
+        try:
+            subprocess.run(
+                ["make", "-s", "-C", str(_DIR)],
+                check=True, capture_output=True, timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            _build_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError:
+        _build_failed = True
+        return None
+    lib.thb_crc32c.restype = ctypes.c_uint32
+    lib.thb_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.thb_masked_crc32c.restype = ctypes.c_uint32
+    lib.thb_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.thb_index_file.restype = ctypes.c_int64
+    lib.thb_index_file.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+    ]
+    lib.thb_free.restype = None
+    lib.thb_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def crc32c(data: bytes) -> int:
+    lib = _load()
+    if lib is None:
+        from tpu_hc_bench.data import tfrecord
+
+        return tfrecord.crc32c(data)
+    return lib.thb_crc32c(data, len(data))
+
+
+def index_tfrecord(
+    path: str | Path, verify: bool = True
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """(payload_offsets, lengths) for every record, or None if native
+    support is unavailable.  Raises IOError on corrupt files."""
+    lib = _load()
+    if lib is None:
+        return None
+    offs = ctypes.POINTER(ctypes.c_uint64)()
+    lens = ctypes.POINTER(ctypes.c_uint64)()
+    n = lib.thb_index_file(
+        str(path).encode(), 1 if verify else 0,
+        ctypes.byref(offs), ctypes.byref(lens),
+    )
+    if n < 0:
+        raise IOError(f"thb_index_file({path}) failed with code {n}")
+    try:
+        offsets = np.ctypeslib.as_array(offs, shape=(n,)).copy() if n else \
+            np.empty((0,), np.uint64)
+        lengths = np.ctypeslib.as_array(lens, shape=(n,)).copy() if n else \
+            np.empty((0,), np.uint64)
+    finally:
+        if n:
+            lib.thb_free(offs)
+            lib.thb_free(lens)
+    return offsets, lengths
+
+
+def read_records_native(path: str | Path, verify: bool = True):
+    """Iterate record payloads using the native index + one buffered read."""
+    idx = index_tfrecord(path, verify=verify)
+    if idx is None:
+        return None
+    offsets, lengths = idx
+    data = Path(path).read_bytes()
+    return [
+        data[int(o) : int(o) + int(l)] for o, l in zip(offsets, lengths)
+    ]

@@ -1,0 +1,127 @@
+"""Environment sanity report — the Singularity ``%runscript`` equivalent.
+
+After every container build the reference runs ``singularity run`` which
+prints and asserts the whole stack: OS, GCC, TF version, MKL linkage +
+``IsMklEnabled()``, Horovod, OFED, MPI/UCX versions
+(``tf-hvd-gcc-ompi-ucx-mlnx.def:45-55``, ``build-container.sh:29-30``) —
+the reference's only integration test (SURVEY.md §4.1).
+
+``python -m tpu_hc_bench.utils.sanity`` plays the same role for the TPU
+stack: python/OS, jax/jaxlib/flax/optax versions, platform + device
+inventory, a compiled-matmul smoke test asserting the XLA backend works
+(the ``IsMklEnabled()`` analog: is the accelerator compiler actually in the
+loop), a collective smoke test, and the env registry contents.  Exit code
+is non-zero on any failed assertion so setup scripts can gate on it.
+"""
+
+from __future__ import annotations
+
+import platform
+import sys
+
+
+def collect_report() -> tuple[list[str], list[str]]:
+    """Returns (report_lines, failures)."""
+    lines: list[str] = []
+    failures: list[str] = []
+
+    lines.append(f"host: {platform.node()} ({platform.platform()})")
+    lines.append(f"python: {sys.version.split()[0]}")
+
+    try:
+        import jax
+        import jaxlib
+
+        lines.append(f"jax: {jax.__version__}  jaxlib: {jaxlib.__version__}")
+    except Exception as e:
+        failures.append(f"jax import failed: {e}")
+        return lines, failures
+
+    for mod in ("flax", "optax", "chex", "numpy"):
+        try:
+            m = __import__(mod)
+            lines.append(f"{mod}: {m.__version__}")
+        except Exception as e:
+            failures.append(f"{mod} import failed: {e}")
+
+    try:
+        devs = jax.devices()
+        lines.append(
+            f"platform: {devs[0].platform}  device_kind: {devs[0].device_kind}"
+        )
+        lines.append(
+            f"devices: {len(devs)} total, {jax.local_device_count()} local, "
+            f"process {jax.process_index()}/{jax.process_count()}"
+        )
+    except Exception as e:
+        failures.append(f"device discovery failed: {e}")
+        return lines, failures
+
+    # compiled-matmul smoke test: the IsMklEnabled() analog — proves the
+    # XLA backend compiles and executes on the accelerator
+    try:
+        import jax.numpy as jnp
+
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        y = jax.jit(lambda a: a @ a)(x)
+        jax.block_until_ready(y)
+        got = float(y[0, 0])
+        if got != 256.0:
+            failures.append(f"matmul smoke test wrong result: {got}")
+        else:
+            lines.append("xla matmul smoke test: ok (256x256 bf16)")
+    except Exception as e:
+        failures.append(f"xla matmul smoke test failed: {e}")
+
+    # collective smoke test (single- or multi-device)
+    try:
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_hc_bench.topology import DATA_AXIS, build_mesh, discover_layout
+
+        mesh = build_mesh(discover_layout())
+        f = jax.jit(jax.shard_map(
+            lambda v: jax.lax.psum(v, DATA_AXIS), mesh=mesh,
+            in_specs=P(DATA_AXIS), out_specs=P(),
+        ))
+        import numpy as np
+
+        n = mesh.devices.size
+        out = f(jnp.arange(float(n)))
+        expect = n * (n - 1) / 2
+        if float(out[0]) != expect:
+            failures.append(f"psum smoke test wrong result: {out}")
+        else:
+            lines.append(f"psum smoke test: ok over {n} device(s)")
+    except Exception as e:
+        failures.append(f"psum smoke test failed: {e}")
+
+    try:
+        from tpu_hc_bench import envfile
+
+        env = envfile.read()
+        lines.append(f"env registry: {len(env)} entries at {envfile.DEFAULT_PATH}")
+    except Exception as e:
+        failures.append(f"env registry read failed: {e}")
+
+    return lines, failures
+
+
+def main() -> int:
+    lines, failures = collect_report()
+    print("=" * 60)
+    print("tpu_hc_bench environment sanity report")
+    print("=" * 60)
+    for line in lines:
+        print(f"  {line}")
+    if failures:
+        print("FAILURES:")
+        for f in failures:
+            print(f"  !! {f}")
+        return 1
+    print("all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
