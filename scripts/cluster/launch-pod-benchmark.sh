@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Fan a benchmark run out to every host of a TPU pod — the mpirun role.
+#
+# The reference's launch is `mpirun -np N -hostfile ~/nodeips.txt … ` from
+# the head node (run-tf-sing-ucx-openmpi.sh:99-109): one command, ranks
+# spawned everywhere.  The TPU equivalent: run the same SPMD launcher on
+# every pod host via the control plane's all-worker SSH; jax.distributed
+# inside each process discovers rank/world from the TPU metadata.
+#
+#   usage: ./launch-pod-benchmark.sh <pod-name> <zone> <NUM_HOSTS> <WORKERS_PER_HOST> <batch_size> <fabric>
+set -euo pipefail
+
+POD="${1:?usage: $0 <pod> <zone> <num_hosts> <workers_per_host> <batch> <fabric>}"
+ZONE="${2:?}"
+NUM_HOSTS="${3:?}"
+WORKERS="${4:?}"
+BATCH="${5:?}"
+FABRIC="${6:?}"
+
+command -v gcloud >/dev/null || { echo "gcloud CLI required" >&2; exit 1; }
+
+gcloud compute tpus tpu-vm ssh "$POD" --zone="$ZONE" --worker=all \
+    --command="cd tpu-hc-bench && ./scripts/run-tpu-ici.sh $NUM_HOSTS $WORKERS $BATCH $FABRIC"

@@ -110,6 +110,9 @@ class BenchmarkConfig:
     fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
     seed: int = 0
     num_classes: int = 1000                   # imagenet label space
+    trace_dir: str | None = None              # jax.profiler trace output; the
+                                              # structured upgrade of the
+                                              # reference's I_MPI_DEBUG tracing
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -206,6 +209,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.fusion_threshold_bytes)
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--num_classes", type=int, default=d.num_classes)
+    p.add_argument("--trace_dir", type=str, default=None)
     return p
 
 

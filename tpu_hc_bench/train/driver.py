@@ -145,6 +145,14 @@ def run_benchmark(
         f"{time.perf_counter() - t_compile:.1f}s (includes compile)"
     )
 
+    # optional jax.profiler trace over the first few timed steps — the
+    # structured replacement for the reference's I_MPI_DEBUG=5 fabric
+    # tracing (run-tf-sing-libfabric-intelmpi.sh:98)
+    tracing = False
+    if cfg.trace_dir:
+        jax.profiler.start_trace(cfg.trace_dir)
+        tracing = True
+
     # --- timed loop (reference num_batches=100, display_every=10) ---
     units = _example_units(cfg, spec)
     step_times: list[float] = []
@@ -155,6 +163,10 @@ def run_benchmark(
         state, metrics = train_step(state, next(batch_iter), rng)
         jax.block_until_ready(metrics["loss"])
         step_times.append(time.perf_counter() - t0)
+        if tracing and i >= min(5, cfg.num_batches):
+            jax.profiler.stop_trace()
+            tracing = False
+            print_fn(f"profiler trace written to {cfg.trace_dir}")
         if i % cfg.display_every == 0 or i == cfg.num_batches:
             now = time.perf_counter()
             window_steps = (

@@ -72,10 +72,12 @@ def make_train_state(
     """Initialize params on host-side abstract init, then TrainState."""
     rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
     inputs = example_batch[0]
-    variables = model.init(
+    # jit the whole init: one compiled program instead of hundreds of eager
+    # ops (eager dispatch is pathological over remote/tunneled devices)
+    init_fn = jax.jit(functools.partial(model.init, train=False))
+    variables = init_fn(
         {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
         jnp.asarray(inputs[:1]),
-        train=False,
     )
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
@@ -134,6 +136,8 @@ def build_train_step(
 
     if fab is fabric_mod.Fabric.HOST:
         return _build_host_step(mesh, cfg, is_text)
+    if cfg.variable_update == "replicated":
+        return _build_gspmd_step(mesh, cfg, is_text)
 
     def device_step(state: TrainState, batch, dropout_rng):
         # per-device: local shard of the batch, replicated state
@@ -191,6 +195,54 @@ def build_train_step(
         return jitted(state, batch, rng)
 
     return step
+
+
+def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
+    """``--variable_update=replicated``: the pure-GSPMD arm.
+
+    No shard_map, no explicit collectives: the step is written over the
+    *global* batch, ``in_shardings`` marks the batch as split over the data
+    axis and the state as replicated, and XLA's SPMD partitioner inserts
+    the gradient all-reduce itself.  This is the idiomatic-JAX counterpart
+    to the explicit Horovod-style psum path, and the A/B between them is
+    the fusion-tuning experiment the reference ran via
+    HOROVOD_FUSION_THRESHOLD (run-tf-sing-ucx-openmpi.sh:105).
+
+    Semantics note: BatchNorm statistics here are computed over the global
+    batch (sync-BN) rather than per-worker — the one observable difference
+    from the Horovod-semantics psum path, inherent to GSPMD.
+    """
+
+    def step_fn(state: TrainState, batch, dropout_rng):
+        if cfg.forward_only:
+            loss, _ = _loss_and_updates(
+                state, state.params, batch, dropout_rng, is_text
+            )
+            return state, {"loss": loss}
+
+        def loss_fn(p):
+            return _loss_and_updates(state, p, batch, dropout_rng, is_text)
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        return new_state, {"loss": loss}
+
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, data, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
 
 
 def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
