@@ -15,21 +15,30 @@ reference-node, worker-unit vs worker-unit.
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 REFERENCE_NODE_IMAGES_PER_SEC = 85.0
 
 
 def main() -> int:
+    # debug/CI escape hatch: BENCH_FORCE_CPU=1 runs the identical protocol
+    # on a virtual 8-device CPU mesh (numbers meaningless, plumbing real)
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+
     from tpu_hc_bench import flags
     from tpu_hc_bench.train import driver
 
     cfg = flags.BenchmarkConfig(
-        batch_size=128,
-        model="resnet50",
+        batch_size=int(os.environ.get("BENCH_BATCH_SIZE", "128")),
+        model=os.environ.get("BENCH_MODEL", "resnet50"),
         use_fp16=True,          # bf16 compute: the TPU-native fast path
-        num_warmup_batches=50,
-        num_batches=100,
+        num_warmup_batches=int(os.environ.get("BENCH_WARMUP", "50")),
+        num_batches=int(os.environ.get("BENCH_BATCHES", "100")),
         display_every=10,
     ).resolve()
 
@@ -39,7 +48,7 @@ def main() -> int:
         print_fn=lambda m: print(m, file=sys.stderr, flush=True),
     )
     print(json.dumps({
-        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "metric": f"{cfg.model}_synthetic_images_per_sec_per_chip",
         "value": round(result.images_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(
