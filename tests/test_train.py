@@ -141,6 +141,27 @@ def test_bert_tiny_mlm_step(mesh8):
     assert losses[-1] < losses[0], losses
 
 
+def test_bert_fused_xent_matches_unfused(mesh8):
+    """--fused_xent (Pallas blocked CE) must match the optax loss path."""
+    from tpu_hc_bench.models import bert
+
+    losses = {}
+    for fused in (False, True):
+        cfg = tiny_cfg(model="bert_base", optimizer="adam",
+                       init_learning_rate=1e-3, fused_xent=fused)
+        model = bert.bert_tiny_mlm()
+        spec = ModelSpec("bert_tiny", None, (16,), 1e6, is_text=True)
+        ds = SyntheticTokens(16, 16, vocab_size=1024)
+        batch = ds.batch()
+        state = step_mod.make_train_state(model, cfg, batch)
+        state = step_mod.replicate_state(state, mesh8)
+        dev_batch = step_mod.shard_batch(batch, mesh8)
+        step_fn = step_mod.build_train_step(mesh8, cfg, spec)
+        _, ls = run_steps(step_fn, state, dev_batch, n=2)
+        losses[fused] = ls
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-4)
+
+
 def test_driver_end_to_end(mesh8):
     cfg = tiny_cfg(model="trivial", num_classes=100)
     out = []

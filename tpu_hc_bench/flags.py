@@ -113,6 +113,8 @@ class BenchmarkConfig:
     trace_dir: str | None = None              # jax.profiler trace output; the
                                               # structured upgrade of the
                                               # reference's I_MPI_DEBUG tracing
+    fused_xent: bool = False                  # Pallas blocked cross-entropy
+                                              # for large-vocab (MLM) heads
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -210,6 +212,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--trace_dir", type=str, default=None)
+    p.add_argument("--fused_xent", type=_parse_bool, default=False)
     return p
 
 

@@ -1,0 +1,9 @@
+"""Hand-written TPU kernels (Pallas) for ops where stock XLA underperforms.
+
+The reference delegates all kernels to MKL-DNN (SURVEY.md §2b #21); this
+framework delegates to XLA:TPU and drops to Pallas only where fusion
+opportunities exceed what the compiler does — currently the large-vocab
+softmax cross-entropy of the BERT MLM head (``ops.xent``).
+"""
+
+from tpu_hc_bench.ops.xent import softmax_xent, softmax_xent_reference  # noqa: F401

@@ -92,7 +92,8 @@ def make_train_state(
     )
 
 
-def _loss_and_updates(state: TrainState, params, batch, dropout_rng, is_text: bool):
+def _loss_and_updates(state: TrainState, params, batch, dropout_rng,
+                      is_text: bool, fused_xent: bool = False):
     """Forward + loss; returns (loss, new_batch_stats)."""
     variables = {"params": params}
     has_stats = bool(state.batch_stats)
@@ -110,7 +111,18 @@ def _loss_and_updates(state: TrainState, params, batch, dropout_rng, is_text: bo
         new_stats = {}
     if is_text:
         _, targets, weights = batch
-        losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        if fused_xent:
+            # Pallas blocked CE: one pass over the [tokens, vocab] logits
+            from tpu_hc_bench.ops import softmax_xent
+
+            b, s, v = logits.shape
+            losses = softmax_xent(
+                logits.reshape(b * s, v), targets.reshape(b * s)
+            ).reshape(b, s)
+        else:
+            losses = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            )
         loss = (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0)
     else:
         _, labels = batch
@@ -146,7 +158,8 @@ def build_train_step(
         )
 
         def loss_fn(p):
-            return _loss_and_updates(state, p, batch, dropout_rng, is_text)
+            return _loss_and_updates(state, p, batch, dropout_rng, is_text,
+                                      cfg.fused_xent)
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -175,7 +188,8 @@ def build_train_step(
     if cfg.forward_only:
         def fwd_only(state, batch, dropout_rng):
             loss, _ = _loss_and_updates(
-                state, state.params, batch, dropout_rng, is_text
+                state, state.params, batch, dropout_rng, is_text,
+                cfg.fused_xent,
             )
             return state, {"loss": jax.lax.pmean(loss, DATA_AXIS)}
         device_step = fwd_only
@@ -216,12 +230,14 @@ def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
     def step_fn(state: TrainState, batch, dropout_rng):
         if cfg.forward_only:
             loss, _ = _loss_and_updates(
-                state, state.params, batch, dropout_rng, is_text
+                state, state.params, batch, dropout_rng, is_text,
+                cfg.fused_xent,
             )
             return state, {"loss": loss}
 
         def loss_fn(p):
-            return _loss_and_updates(state, p, batch, dropout_rng, is_text)
+            return _loss_and_updates(state, p, batch, dropout_rng, is_text,
+                                      cfg.fused_xent)
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -259,7 +275,8 @@ def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
         )
 
         def loss_fn(p):
-            return _loss_and_updates(state, p, batch, dropout_rng, is_text)
+            return _loss_and_updates(state, p, batch, dropout_rng, is_text,
+                                      cfg.fused_xent)
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
