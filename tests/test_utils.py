@@ -1,0 +1,75 @@
+"""Checkpoint, sanity-report, hostfile, and hw-table tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hc_bench import flags
+from tpu_hc_bench.data.synthetic import SyntheticImages
+from tpu_hc_bench.models import TrivialModel
+from tpu_hc_bench.parallel import distributed
+from tpu_hc_bench.train import step as step_mod
+from tpu_hc_bench.utils import checkpoint, hw, sanity
+
+
+def make_state(lr=0.05):
+    cfg = flags.BenchmarkConfig(
+        batch_size=2, model="trivial", num_classes=10,
+        init_learning_rate=lr,
+    ).resolve()
+    model = TrivialModel(num_classes=10)
+    batch = SyntheticImages(8, (8, 8, 3), num_classes=10).batch()
+    return step_mod.make_train_state(model, cfg, batch), batch
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state, _ = make_state()
+    state = state.replace(step=jnp.asarray(7, jnp.int32))
+    checkpoint.save(state, tmp_path)
+    assert checkpoint.latest_step(tmp_path) == 7
+
+    fresh, _ = make_state()
+    restored = checkpoint.restore(fresh, tmp_path)
+    assert int(restored.step) == 7
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_multiple_steps(tmp_path):
+    state, _ = make_state()
+    for s in (1, 5, 3):
+        checkpoint.save(state.replace(step=jnp.asarray(s, jnp.int32)), tmp_path)
+    assert checkpoint.latest_step(tmp_path) == 5
+    restored = checkpoint.restore(make_state()[0], tmp_path, step=3)
+    assert int(restored.step) == 3
+
+
+def test_restore_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        checkpoint.restore(make_state()[0], tmp_path / "nope")
+
+
+def test_sanity_report_passes_on_cpu_mesh(devices):
+    lines, failures = sanity.collect_report()
+    assert failures == [], failures
+    text = "\n".join(lines)
+    assert "jax:" in text and "matmul smoke test: ok" in text
+    assert "psum smoke test: ok over 8 device(s)" in text
+
+
+def test_hostfile_parsing(tmp_path):
+    p = tmp_path / "nodeips.txt"
+    p.write_text("# head node first\n10.0.0.1\n10.0.0.2\n\n10.0.0.3\n")
+    hosts = distributed.read_hostfile(p)
+    assert hosts == ["10.0.0.1", "10.0.0.2", "10.0.0.3"]
+    (tmp_path / "empty.txt").write_text("\n# nothing\n")
+    with pytest.raises(ValueError):
+        distributed.read_hostfile(tmp_path / "empty.txt")
+
+
+def test_peak_flops_table():
+    # CPU test devices fall into the nominal row
+    assert hw.peak_flops(dtype="bfloat16") > 0
+    assert hw.peak_flops(dtype="float32") > 0

@@ -50,7 +50,7 @@ def _busbw_factor(op: str, n: int) -> float:
         return 1.0
     if op == "allreduce":
         return 2.0 * (n - 1) / n
-    if op in ("all_gather", "reduce_scatter"):
+    if op in ("all_gather", "reduce_scatter", "all_to_all"):
         return (n - 1) / n
     return 1.0  # ppermute: each link carries the full message once
 
@@ -82,10 +82,17 @@ def _collective(op: str, axis: str) -> Callable[[jax.Array], jax.Array]:
             perm = [(i, (i + 1) % n) for i in range(n)]
             return jax.lax.ppermute(x, axis, perm)
         return f
+    if op == "all_to_all":
+        # osu_alltoall analog — the building block of expert/sequence
+        # parallelism layouts; shape-preserving tiled exchange
+        return lambda x: jax.lax.all_to_all(
+            x, axis, split_axis=0, concat_axis=0, tiled=True
+        )
     raise ValueError(f"unknown op {op!r}")
 
 
-OSU_OPS = ("allreduce", "all_gather", "reduce_scatter", "ppermute")
+OSU_OPS = ("allreduce", "all_gather", "reduce_scatter", "ppermute",
+           "all_to_all")
 
 
 def _build_timed_fn(mesh: Mesh, op: str, iters: int):

@@ -58,6 +58,24 @@ def _example_units(cfg: BenchmarkConfig, spec) -> str:
     return "examples" if spec.is_text else "images"
 
 
+def _prefetch(gen, lookahead: int = 2):
+    """Keep `lookahead` device batches in flight.
+
+    jax.device_put is asynchronous, so pulling the generator ahead of the
+    consumer overlaps host decode + host->device DMA with the running step
+    (the tf.data prefetch-to-device role in the reference's pipeline).
+    """
+    import collections
+
+    q = collections.deque()
+    for item in gen:
+        q.append(item)
+        if len(q) >= lookahead:
+            yield q.popleft()
+    while q:
+        yield q.popleft()
+
+
 def run_benchmark(
     cfg: BenchmarkConfig,
     layout: Layout | None = None,
@@ -103,9 +121,11 @@ def run_benchmark(
         batch = next(host_iter)
 
         def batches():
-            yield step_mod.shard_batch(batch, mesh)
-            for b in host_iter:
-                yield step_mod.shard_batch(b, mesh)
+            def raw():
+                yield step_mod.shard_batch(batch, mesh)
+                for b in host_iter:
+                    yield step_mod.shard_batch(b, mesh)
+            yield from _prefetch(raw())
     elif spec.is_text:
         seq_len = spec.input_shape[0]
         ds = SyntheticTokens(global_batch, seq_len, seed=cfg.seed)

@@ -1,0 +1,82 @@
+"""Checkpoint/resume for TrainState (Orbax-backed).
+
+The reference has **no** checkpointing (SURVEY.md §5: benchmark runs are
+stateless 150-step measurements) — this subsystem exceeds parity so the
+framework is usable for real training runs, not just benchmarks.  Layout:
+one Orbax PyTree checkpoint per step under ``<dir>/step_<n>``, with
+``latest_step`` discovery for resume.  Only array/step state is saved;
+``apply_fn``/``tx`` are reconstructed from config at restore (standard JAX
+practice — function objects don't serialize).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tpu_hc_bench.train.step import TrainState
+
+
+def _step_dir(base: Path, step: int) -> Path:
+    return base / f"step_{step:08d}"
+
+
+def save(state: TrainState, directory: str | Path) -> Path:
+    """Save the array state of `state` at its current step."""
+    base = Path(directory)
+    base.mkdir(parents=True, exist_ok=True)
+    step = int(jax.device_get(state.step))
+    path = _step_dir(base, step)
+    payload = {
+        "step": np.asarray(step),
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+    }
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path.resolve(), payload, force=True)
+    return path
+
+
+def latest_step(directory: str | Path) -> int | None:
+    base = Path(directory)
+    if not base.exists():
+        return None
+    steps = [
+        int(m.group(1))
+        for p in base.iterdir()
+        if (m := re.fullmatch(r"step_(\d+)", p.name))
+    ]
+    return max(steps) if steps else None
+
+
+def restore(state: TrainState, directory: str | Path,
+            step: int | None = None) -> TrainState:
+    """Restore into an already-constructed (template) TrainState.
+
+    ``state`` supplies the tree structure, dtypes, and the non-serializable
+    ``apply_fn``/``tx``; arrays are replaced from the checkpoint.
+    """
+    base = Path(directory)
+    if step is None:
+        step = latest_step(base)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {base}")
+    template = {
+        "step": jax.device_get(state.step),
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+    }
+    ckptr = ocp.PyTreeCheckpointer()
+    payload = ckptr.restore(_step_dir(base, step).resolve(), item=template)
+    return state.replace(
+        step=jax.numpy.asarray(payload["step"], dtype=jax.numpy.int32),
+        params=payload["params"],
+        batch_stats=payload["batch_stats"],
+        opt_state=payload["opt_state"],
+    )
