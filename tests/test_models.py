@@ -80,6 +80,24 @@ def test_inception3_params_and_shape():
     assert out.shape == (1, 1000)
 
 
+def test_alexnet_params_and_shape():
+    model, spec, variables, x = init_model("alexnet")
+    count = n_params(variables["params"])
+    # single-tower AlexNet ~61M
+    assert abs(count - 61e6) / 61e6 < 0.05, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+
+
+def test_googlenet_params_and_shape():
+    model, spec, variables, x = init_model("googlenet")
+    count = n_params(variables["params"])
+    # GoogLeNet ~6.6M (no aux heads)
+    assert abs(count - 6.6e6) / 6.6e6 < 0.1, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+
+
 def test_bert_base_params():
     model = bert.BertMLM()
     x = jnp.zeros((1, 128), jnp.int32)

@@ -202,6 +202,16 @@ def test_driver_real_tfrecord_data(mesh8, tmp_path):
     assert any("real" in l or str(tmp_path) in l for l in out)
 
 
+def test_driver_eval_mode(mesh8):
+    """--eval: forward-only protocol reporting top-1 accuracy."""
+    cfg = tiny_cfg(model="trivial", num_classes=10, eval=True, num_batches=3)
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    text = "\n".join(out)
+    assert "eval top_1 accuracy:" in text
+    assert res.total_images_per_sec > 0
+
+
 def test_log_name_convention():
     # reference: tfmn-<n>n-<b>b-<data>-<fabric>-r<run>.log (:9-12)
     assert driver.log_name(4, 64, "synthetic", "ici", 1) == \

@@ -78,6 +78,8 @@ class BenchmarkConfig:
     display_every: int = DEFAULT_DISPLAY_EVERY
     optimizer: str = "momentum"               # --optimizer=momentum (:74)
     forward_only: bool = False                # --forward_only=False (:75)
+    eval: bool = False                        # tf_cnn_benchmarks --eval:
+                                              # forward + top-1 accuracy
     init_learning_rate: float = 0.01          # tf_cnn_benchmarks flag; the
                                               # reference leaves the default
     momentum: float = 0.9                     # tf_cnn_benchmarks default
@@ -188,6 +190,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", type=str, default=d.optimizer,
                    choices=["momentum", "sgd", "adam", "adamw", "rmsprop"])
     p.add_argument("--forward_only", type=_parse_bool, default=d.forward_only)
+    p.add_argument("--eval", type=_parse_bool, default=False)
     p.add_argument("--init_learning_rate", type=float, default=d.init_learning_rate)
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--data_dir", type=str, default=None)

@@ -45,10 +45,14 @@ class ModelSpec:
 
 
 def _registry() -> dict[str, ModelSpec]:
-    from tpu_hc_bench.models import resnet, vgg, inception, bert
+    from tpu_hc_bench.models import (
+        alexnet, bert, googlenet, inception, resnet, vgg,
+    )
 
     specs = [
         ModelSpec("trivial", TrivialModel, (224, 224, 3), 2 * 150528 * 1000),
+        ModelSpec("alexnet", alexnet.alexnet, (224, 224, 3), 1.43e9),
+        ModelSpec("googlenet", googlenet.googlenet, (224, 224, 3), 3.0e9),
         # ResNet fwd GFLOPs at 224^2 (2*MACs): v1.5 figures
         ModelSpec("resnet18", resnet.resnet18, (224, 224, 3), 3.64e9),
         ModelSpec("resnet34", resnet.resnet34, (224, 224, 3), 7.34e9),

@@ -1,0 +1,53 @@
+"""AlexNet in Flax (tf_cnn_benchmarks model zoo member `alexnet`).
+
+Single-tower AlexNet as tf_cnn_benchmarks drives it (Krizhevsky 2014
+one-GPU variant): five convs, three max-pools, two 4096-wide FC layers.
+The FCs are the bulk of the ~61M parameters and are pure MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        # pad 2 so a 224 input reproduces the canonical 227-input (Caffe)
+        # spatial pipeline: 55 -> 27 -> 13 -> 6, giving the 9216-wide fc6
+        x = nn.Conv(64, (11, 11), strides=(4, 4), padding=((2, 2), (2, 2)),
+                    dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(192, (5, 5), padding="SAME", dtype=self.dtype,
+                    name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(384, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv3")(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv4")(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv5")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc6")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc7")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc8")(x)
+        return x.astype(jnp.float32)
+
+
+def alexnet(num_classes=1000, dtype=jnp.float32):
+    return AlexNet(num_classes=num_classes, dtype=dtype)

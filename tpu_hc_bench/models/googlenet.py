@@ -1,0 +1,74 @@
+"""GoogLeNet / Inception-v1 in Flax (tf_cnn_benchmarks `googlenet`).
+
+Classic Szegedy 2014 architecture: stem, nine inception modules with
+1x1/3x3/5x5 branches + pooled projection, global average pool, single
+classifier (aux heads omitted — benchmark runs never consume them),
+~6.6M parameters, no batch norm.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class InceptionModule(nn.Module):
+    f1: int          # 1x1 branch
+    f3r: int         # 3x3 reduce
+    f3: int
+    f5r: int         # 5x5 reduce
+    f5: int
+    fp: int          # pool projection
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = lambda f, k, name: nn.Conv(
+            f, (k, k), padding="SAME", dtype=self.dtype, name=name
+        )
+        b1 = nn.relu(conv(self.f1, 1, "b1")(x))
+        b3 = nn.relu(conv(self.f3r, 1, "b3r")(x))
+        b3 = nn.relu(conv(self.f3, 3, "b3")(b3))
+        b5 = nn.relu(conv(self.f5r, 1, "b5r")(x))
+        b5 = nn.relu(conv(self.f5, 5, "b5")(b5))
+        bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = nn.relu(conv(self.fp, 1, "bp")(bp))
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        x = x.astype(d)
+        x = nn.relu(nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME",
+                            dtype=d, name="conv1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(nn.Conv(64, (1, 1), dtype=d, name="conv2r")(x))
+        x = nn.relu(nn.Conv(192, (3, 3), padding="SAME", dtype=d,
+                            name="conv2")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionModule(64, 96, 128, 16, 32, 32, dtype=d)(x)    # 3a
+        x = InceptionModule(128, 128, 192, 32, 96, 64, dtype=d)(x)  # 3b
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionModule(192, 96, 208, 16, 48, 64, dtype=d)(x)   # 4a
+        x = InceptionModule(160, 112, 224, 24, 64, 64, dtype=d)(x)  # 4b
+        x = InceptionModule(128, 128, 256, 24, 64, 64, dtype=d)(x)  # 4c
+        x = InceptionModule(112, 144, 288, 32, 64, 64, dtype=d)(x)  # 4d
+        x = InceptionModule(256, 160, 320, 32, 128, 128, dtype=d)(x)  # 4e
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = InceptionModule(256, 160, 320, 32, 128, 128, dtype=d)(x)  # 5a
+        x = InceptionModule(384, 192, 384, 48, 128, 128, dtype=d)(x)  # 5b
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.4, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def googlenet(num_classes=1000, dtype=jnp.float32):
+    return GoogLeNet(num_classes=num_classes, dtype=dtype)

@@ -76,6 +76,54 @@ def _prefetch(gen, lookahead: int = 2):
         yield q.popleft()
 
 
+def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
+              fab, print_fn):
+    """tf_cnn_benchmarks --eval: timed forward passes + top-1 accuracy."""
+    from tpu_hc_bench.train import step as step_mod
+
+    eval_step = step_mod.build_eval_step(mesh, cfg, spec)
+    units = _example_units(cfg, spec)
+    for _ in range(max(1, min(cfg.num_warmup_batches, 5))):
+        loss, correct = eval_step(state, next(batch_iter))
+    jax.block_until_ready(loss)
+
+    correct_total = 0.0
+    seen = 0
+    step_times = []
+    for i in range(1, cfg.num_batches + 1):
+        t0 = time.perf_counter()
+        loss, correct = eval_step(state, next(batch_iter))
+        jax.block_until_ready(loss)
+        step_times.append(time.perf_counter() - t0)
+        correct_total += float(jax.device_get(correct))
+        seen += global_batch
+        if i % cfg.display_every == 0 or i == cfg.num_batches:
+            print_fn(
+                f"{i}\ttop_1: {correct_total / seen:.4f}\t"
+                f"loss: {float(jax.device_get(loss)):.3f}"
+            )
+    total_time = sum(step_times)
+    total_rate = cfg.num_batches * global_batch / total_time
+    per_chip = total_rate / layout.total_workers
+    peak = hw.peak_flops(dtype=cfg.compute_dtype)
+    result = BenchmarkResult(
+        model=cfg.model,
+        total_workers=layout.total_workers,
+        global_batch=global_batch,
+        total_images_per_sec=total_rate,
+        images_per_sec_per_chip=per_chip,
+        mean_step_ms=1e3 * total_time / cfg.num_batches,
+        p50_step_ms=1e3 * statistics.median(step_times),
+        mfu=(spec.flops_per_example * per_chip) / peak,
+        final_loss=float(jax.device_get(loss)),
+        fabric=fab.value,
+    )
+    print_fn("-" * 40)
+    print_fn(f"eval top_1 accuracy: {correct_total / seen:.4f}")
+    print_fn(f"total {units}/sec: {total_rate:.2f}")
+    return result
+
+
 def run_benchmark(
     cfg: BenchmarkConfig,
     layout: Layout | None = None,
@@ -109,10 +157,23 @@ def run_benchmark(
         from tpu_hc_bench.data.imagenet import ImageNetDataset
 
         image_size = spec.default_image_size
+        split = "train"
+        if cfg.eval:
+            # prefer a validation split when present (standard layout);
+            # fall back to train shards otherwise
+            from tpu_hc_bench.data.imagenet import find_shards
+
+            try:
+                find_shards(cfg.data_dir, "validation")
+                split = "validation"
+            except FileNotFoundError:
+                pass
         ds = ImageNetDataset(
             cfg.data_dir,
             global_batch=global_batch,
             image_size=image_size,
+            split=split,
+            train=not cfg.eval,
             worker=jax.process_index(),
             num_workers=jax.process_count(),
             seed=cfg.seed,
@@ -151,6 +212,11 @@ def run_benchmark(
     state = step_mod.make_train_state(model, cfg, batch)
     state = step_mod.replicate_state(state, mesh)
     batch_iter = batches()
+    if cfg.eval:
+        return _run_eval(
+            cfg, spec, layout, mesh, state, batch_iter, global_batch,
+            fab, print_fn,
+        )
     train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
     rng = jax.random.PRNGKey(cfg.seed + 17)
 

@@ -313,6 +313,49 @@ def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
     return step
 
 
+def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
+    """Eval step (tf_cnn_benchmarks --eval): forward pass, loss + top-1.
+
+    Uses running BN statistics (``train=False``) and no dropout.  Returns
+    ``(loss, correct_count)`` reduced over the mesh.
+    """
+    is_text = spec.is_text
+
+    def device_eval(state: TrainState, batch):
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = state.apply_fn(variables, batch[0], train=False)
+        if is_text:
+            _, targets, weights = batch
+            losses = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            )
+            loss = (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+            correct = jnp.sum(
+                (jnp.argmax(logits, -1) == targets) * weights
+            )
+        else:
+            _, labels = batch
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            correct = jnp.sum(jnp.argmax(logits, -1) == labels)
+        return (
+            jax.lax.pmean(loss, DATA_AXIS),
+            jax.lax.psum(correct.astype(jnp.float32), DATA_AXIS),
+        )
+
+    shard_fn = jax.shard_map(
+        device_eval,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Place the state replicated over the mesh (params live on-device)."""
     sharding = NamedSharding(mesh, P())
