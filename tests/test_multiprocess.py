@@ -81,9 +81,20 @@ def test_two_process_hostfile_allreduce(tmp_path):
             text=True,
         ))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=180)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        # one worker hanging must not leak its sibling (it would wedge CI);
+        # kill both and surface whatever output they produced
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                out, _ = p.communicate()
+                outs.append(out)
+        import pytest
+        pytest.fail("worker timed out; captured output:\n" + "\n---\n".join(outs))
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
         assert "MP_OK" in out
