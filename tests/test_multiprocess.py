@@ -85,14 +85,19 @@ def test_two_process_hostfile_allreduce(tmp_path):
         for p in procs:
             out, _ = p.communicate(timeout=180)
             outs.append(out)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         # one worker hanging must not leak its sibling (it would wedge CI);
-        # kill both and surface whatever output they produced
+        # kill everything, then drain ALL pipes — including the partial
+        # output attached to the timeout itself and any already-exited
+        # sibling not yet communicate()d
+        if e.output is not None:
+            outs.append(e.output)
         for p in procs:
             if p.poll() is None:
                 p.kill()
-                out, _ = p.communicate()
-                outs.append(out)
+        for p in procs[len(outs):]:
+            out, _ = p.communicate()
+            outs.append(out)
         import pytest
         pytest.fail("worker timed out; captured output:\n" + "\n---\n".join(outs))
     for i, (p, out) in enumerate(zip(procs, outs)):

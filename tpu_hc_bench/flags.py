@@ -117,6 +117,9 @@ class BenchmarkConfig:
                                               # reference's I_MPI_DEBUG tracing
     fused_xent: bool = False                  # Pallas blocked cross-entropy
                                               # for large-vocab (MLM) heads
+    attention_impl: str = "dense"             # dense|flash: transformer
+                                              # attention kernel (flash =
+                                              # Pallas blocked softmax)
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -216,6 +219,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--trace_dir", type=str, default=None)
     p.add_argument("--fused_xent", type=_parse_bool, default=False)
+    p.add_argument("--attention_impl", type=str, default=d.attention_impl,
+                   choices=["dense", "flash"])
     return p
 
 

@@ -89,6 +89,10 @@ def list_models() -> list[str]:
     return sorted(_registry())
 
 
-def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32):
+def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
+                 attention_impl: str = "dense"):
     spec = get_model_spec(name)
-    return spec.create(num_classes=num_classes, dtype=dtype), spec
+    kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
+    if spec.is_text:   # attention kernel choice only exists for transformers
+        kwargs["attention_impl"] = attention_impl
+    return spec.create(**kwargs), spec

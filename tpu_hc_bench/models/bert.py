@@ -24,22 +24,62 @@ BERT_BASE_FFN = 3072
 BERT_MAX_LEN = 512
 
 
+class MultiHeadAttention(nn.Module):
+    """Self-attention whose inner product routes through the framework's
+    attention dispatch (``parallel.sequence.local_attention``), so one
+    param layout serves every impl: ``dense`` (XLA), ``flash`` (Pallas
+    blocked-softmax kernel), and — inside a shard_map with a bound seq
+    axis — ``ring``/``ulysses`` sequence parallelism.
+
+    Unlike ``nn.MultiHeadDotProductAttention`` there is no dropout on the
+    attention probabilities (a flash kernel never materializes them); the
+    residual-path dropout in ``TransformerLayer`` is retained.
+    """
+
+    hidden: int
+    heads: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.hidden // self.heads
+        qkv = nn.DenseGeneral((3, self.heads, d), dtype=self.dtype,
+                              name="qkv")(x)
+        q, k, v = (qkv[:, :, a] for a in range(3))
+        from tpu_hc_bench.parallel.sequence import local_attention
+
+        out = local_attention(q, k, v, impl=self.attention_impl,
+                              axis_name=self.seq_axis)
+        return nn.DenseGeneral(self.hidden, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(out)
+
+
 class TransformerLayer(nn.Module):
     hidden: int
     heads: int
     ffn: int
     dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True):
+        if mask is not None:
+            raise NotImplementedError(
+                "attention masks are not supported: the MLM protocol uses "
+                "fixed-length sequences (masking lives in the loss); pass "
+                "mask=None"
+            )
         # post-LN (original BERT): sublayer -> dropout -> add -> LN
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads,
-            qkv_features=self.hidden,
-            dtype=self.dtype,
-            deterministic=not train,
-            dropout_rate=0.1,
-        )(x, x, mask=mask)
+        # NOTE deliberate deviation from nn.MultiHeadDotProductAttention:
+        # no dropout on attention probabilities for ANY impl (a flash
+        # kernel never materializes them); residual dropout is kept.
+        attn = MultiHeadAttention(
+            self.hidden, self.heads, dtype=self.dtype,
+            attention_impl=self.attention_impl, seq_axis=self.seq_axis,
+        )(x)
         attn = nn.Dropout(0.1, deterministic=not train)(attn)
         x = nn.LayerNorm(dtype=self.dtype)(x + attn)
         y = nn.Dense(self.ffn, dtype=self.dtype)(x)
@@ -57,6 +97,8 @@ class BertMLM(nn.Module):
     ffn: int = BERT_BASE_FFN
     max_len: int = BERT_MAX_LEN
     dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True):
@@ -72,6 +114,7 @@ class BertMLM(nn.Module):
         for i in range(self.num_layers):
             x = TransformerLayer(
                 self.hidden, self.heads, self.ffn, dtype=self.dtype,
+                attention_impl=self.attention_impl, seq_axis=self.seq_axis,
                 name=f"layer_{i}",
             )(x, train=train)
         # MLM head: dense+gelu+LN, then tied-embedding projection
@@ -83,15 +126,16 @@ class BertMLM(nn.Module):
         return logits + bias
 
 
-def bert_base_mlm(num_classes: int = 0, dtype=jnp.float32):
+def bert_base_mlm(num_classes: int = 0, dtype=jnp.float32,
+                  attention_impl: str = "dense"):
     """Registry adapter; num_classes is ignored (vocab is the label space)."""
     del num_classes
-    return BertMLM(dtype=dtype)
+    return BertMLM(dtype=dtype, attention_impl=attention_impl)
 
 
-def bert_tiny_mlm(dtype=jnp.float32):
+def bert_tiny_mlm(dtype=jnp.float32, attention_impl: str = "dense"):
     """4-layer/128-hidden variant for tests and CPU smoke runs."""
     return BertMLM(
         vocab_size=1024, hidden=128, num_layers=4, heads=4, ffn=512,
-        max_len=128, dtype=dtype,
+        max_len=128, dtype=dtype, attention_impl=attention_impl,
     )

@@ -6,4 +6,5 @@ opportunities exceed what the compiler does — currently the large-vocab
 softmax cross-entropy of the BERT MLM head (``ops.xent``).
 """
 
+from tpu_hc_bench.ops.flash_attention import flash_attention  # noqa: F401
 from tpu_hc_bench.ops.xent import softmax_xent, softmax_xent_reference  # noqa: F401

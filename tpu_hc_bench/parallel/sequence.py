@@ -123,7 +123,8 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     Re-shards [batch, local_seq, heads, head_dim] -> [batch, global_seq,
     local_heads, head_dim] with one ``all_to_all``, runs full-sequence
     attention on the local head group, then reverses the exchange.  Needs
-    ``heads % axis_size == 0``.  ``attn_fn(q, k, v)`` overrides the local
+    ``heads % axis_size == 0``.  ``attn_fn(q, k, v, causal=..., scale=...)``
+    (always called with those keywords forwarded) overrides the local
     attention (e.g. a Pallas flash kernel); default is ``dense_attention``.
     """
     n = jax.lax.axis_size(axis_name)
@@ -143,13 +144,12 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     # one stacked exchange for q/k/v instead of three collective launches
     qg, kg, vg = seq_to_heads(jnp.stack((q, k, v)))
     if attn_fn is None:
-        attn_fn = functools.partial(dense_attention, causal=causal,
-                                    scale=scale)
-    out = attn_fn(qg, kg, vg)
+        attn_fn = dense_attention
+    out = attn_fn(qg, kg, vg, causal=causal, scale=scale)
     return heads_to_seq(out)
 
 
-_IMPLS = {"dense", "ring", "ulysses"}
+_IMPLS = {"dense", "flash", "ring", "ulysses"}
 
 
 def local_attention(q, k, v, impl: str = "dense",
@@ -157,8 +157,10 @@ def local_attention(q, k, v, impl: str = "dense",
                     scale: float | None = None):
     """Dispatch: the one attention entry point model code calls.
 
-    ``impl='dense'`` ignores ``axis_name`` (each shard attends locally —
-    only correct unsharded); ``ring``/``ulysses`` require ``axis_name``.
+    ``impl='dense'``/``'flash'`` ignore ``axis_name`` (each shard attends
+    locally — only correct unsharded); ``ring``/``ulysses`` require
+    ``axis_name``.  ``flash`` is the Pallas blocked-softmax kernel
+    (``ops.flash_attention``); ``dense`` is the XLA-compiled reference.
     """
     if impl not in _IMPLS:
         raise ValueError(
@@ -166,6 +168,10 @@ def local_attention(q, k, v, impl: str = "dense",
         )
     if impl == "dense":
         return dense_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "flash":
+        from tpu_hc_bench.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     if axis_name is None:
         raise ValueError(f"impl={impl!r} requires axis_name (a bound mesh axis)")
     if impl == "ring":

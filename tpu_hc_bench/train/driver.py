@@ -140,7 +140,8 @@ def run_benchmark(
     global_batch = layout.global_batch(cfg.batch_size)
 
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
-    model, spec = create_model(cfg.model, num_classes=cfg.num_classes, dtype=dtype)
+    model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
+                               dtype=dtype, attention_impl=cfg.attention_impl)
 
     # --- banner (reference :52-58 config echo) ---
     for line in layout.summary_lines(fabric=fab.value):
