@@ -91,13 +91,18 @@ def test_two_process_hostfile_allreduce(tmp_path):
         # output attached to the timeout itself and any already-exited
         # sibling not yet communicate()d
         if e.output is not None:
-            outs.append(e.output)
+            # TimeoutExpired carries bytes even under text=True
+            outs.append(e.output.decode(errors="replace")
+                        if isinstance(e.output, bytes) else e.output)
         for p in procs:
             if p.poll() is None:
                 p.kill()
         for p in procs[len(outs):]:
             out, _ = p.communicate()
             outs.append(out)
+        for p in procs:         # reap the killed timed-out process too
+            if p.returncode is None:
+                p.wait()
         import pytest
         pytest.fail("worker timed out; captured output:\n" + "\n---\n".join(outs))
     for i, (p, out) in enumerate(zip(procs, outs)):

@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_hc_bench.topology import DATA_AXIS, discover_layout, build_mesh
+from tpu_hc_bench.utils.sync import drain
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,12 +138,13 @@ def run_sweep(
         x = jax.device_put(
             jnp.ones((elems_per_dev * n,), dtype), sharding
         )
-        # warmup (includes compile)
+        # warmup (includes compile); drain, not block_until_ready — the
+        # latter is advisory on tunneled platforms (utils.sync)
         w = _build_timed_fn(mesh, op, warmup)
-        jax.block_until_ready(w(x))
-        jax.block_until_ready(fn(x))  # compile the timed fn
+        drain(w(x))
+        drain(fn(x))  # compile the timed fn
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(x))
+        drain(fn(x))
         dt = time.perf_counter() - t0
         per_op = dt / iters
         msg_bytes = elems_per_dev * itemsize

@@ -1,0 +1,59 @@
+"""Reliable device synchronization for timing and draining.
+
+``jax.block_until_ready`` is the documented way to wait for async
+dispatch, but on tunneled platforms (the ``axon`` remote-TPU bridge in
+particular) it is advisory: it — and ``jax.Array.is_ready()`` — report
+completion early once the dispatch queue is deep (observed: truthful up
+to ~30 outstanding executions, then unconditionally "ready", while a
+value fetch of the same buffer still takes the full remaining execution
+time).  The only trustworthy completion signal there is a value fetch,
+so ``drain`` fetches: small leaves directly, large leaves through a
+one-element dependent slice (forces execution without moving the
+buffer).
+
+Timing code should not call ``drain`` per step — a scalar fetch costs a
+full tunnel round trip (~0.1s observed) — but pipeline fetches through a
+background thread so the constant RTT cancels in arrival-time deltas
+(see ``train.driver``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Leaves at or below this size are fetched whole; larger ones through a
+# 1-element slice so the drain never moves real buffers over the wire.
+_SMALL_BYTES = 16384
+
+
+def drain(tree):
+    """Force true completion of every array in a pytree; returns the tree.
+
+    Cost: one host round trip (plus tiny probe dispatches for large
+    leaves).  Correct on every platform, including ones where
+    block_until_ready/is_ready are advisory.
+    """
+    leaves = [x for x in jax.tree.leaves(tree) if isinstance(x, jax.Array)]
+    if not leaves:
+        return tree
+    # cheap and sufficient on well-behaved platforms; advisory on axon
+    jax.block_until_ready(leaves)
+    probes = []
+    for leaf in leaves:
+        if not leaf.is_fully_addressable:
+            # multi-process global array: values can't be fetched from one
+            # process.  block_until_ready above is all we can do — fine in
+            # practice, since the advisory-sync tunnel is single-process.
+            continue
+        if leaf.size and leaf.nbytes > _SMALL_BYTES:
+            probes.append(leaf.ravel()[0])
+        else:
+            probes.append(leaf)
+    if probes:
+        jax.device_get(probes)
+    return tree
+
+
+def wait_ready(x) -> None:
+    """Wait for one array's computation to truly finish (one RTT)."""
+    drain(x)
