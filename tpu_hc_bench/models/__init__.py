@@ -65,6 +65,9 @@ def _registry() -> dict[str, ModelSpec]:
                   default_image_size=299),
         ModelSpec("bert_base", bert.bert_base_mlm, (128,), 2 * 110e6 * 128,
                   is_text=True),
+        # ~4.5M params, seq 64: CPU-smoke/test variant of the MLM path
+        ModelSpec("bert_tiny", bert.bert_tiny_mlm, (64,), 2 * 4.5e6 * 64,
+                  is_text=True),
     ]
     return {s.name: s for s in specs}
 

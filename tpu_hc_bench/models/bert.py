@@ -133,8 +133,10 @@ def bert_base_mlm(num_classes: int = 0, dtype=jnp.float32,
     return BertMLM(dtype=dtype, attention_impl=attention_impl)
 
 
-def bert_tiny_mlm(dtype=jnp.float32, attention_impl: str = "dense"):
+def bert_tiny_mlm(num_classes: int = 0, dtype=jnp.float32,
+                  attention_impl: str = "dense"):
     """4-layer/128-hidden variant for tests and CPU smoke runs."""
+    del num_classes
     return BertMLM(
         vocab_size=1024, hidden=128, num_layers=4, heads=4, ffn=512,
         max_len=128, dtype=dtype, attention_impl=attention_impl,

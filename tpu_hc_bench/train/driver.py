@@ -90,8 +90,15 @@ class _ArrivalFetcher:
     is a value fetch — which costs a full RTT.  Fetching from a side
     thread keeps the RTT out of the dispatch path, and because every
     arrival is late by the same constant RTT, arrival-time *deltas*
-    measure true device progress.  The enqueue loop uses
-    ``fetched_step`` for flow control (bounding in-flight steps).
+    measure true device progress.
+
+    When markers complete faster than one RTT the fetch queue would back
+    up and the deltas would measure fetch serialization instead, so the
+    thread *coalesces*: whenever several markers are already queued it
+    timing-fetches only the newest and parks the rest in ``skipped``
+    (their values are fetched after the run, when everything is complete
+    and fetches are cheap).  The enqueue loop uses ``fetched_step`` for
+    flow control (bounding in-flight steps).
     """
 
     def __init__(self):
@@ -100,6 +107,7 @@ class _ArrivalFetcher:
 
         self._q: queue.Queue = queue.Queue()
         self.arrivals: list[tuple[int, float, object]] = []
+        self.skipped: list[tuple[int, object]] = []   # coalesced-over markers
         self.fetched_step = 0
         self.error: BaseException | None = None
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -115,10 +123,22 @@ class _ArrivalFetcher:
             raise self.error
 
     def _run(self) -> None:
+        import queue as queue_mod
+
         while True:
             item = self._q.get()
             if item is None:
                 return
+            while True:         # coalesce everything already queued
+                try:
+                    nxt = self._q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if nxt is None:
+                    self._q.put(None)   # re-arm sentinel for the outer loop
+                    break
+                self.skipped.append(item)
+                item = nxt
             i, h = item
             try:
                 v = jax.device_get(h)
@@ -136,6 +156,77 @@ class _ArrivalFetcher:
         return self.arrivals
 
 
+class _AsyncTimeline:
+    """The measurement protocol shared by the train and eval loops.
+
+    Wraps an _ArrivalFetcher with the marker cadence (sync/display
+    points), HBM flow control, and the post-run reconstruction of the
+    windowed timeline.  Display steps that were coalesced over inherit
+    the mean rate of the enclosing timed span; the final step is always
+    timed (it is the newest marker when the queue drains), so the total
+    is exact.
+    """
+
+    def __init__(self, num_batches: int, display_every: int,
+                 global_batch: int):
+        self.num_batches = num_batches
+        self.display_every = display_every
+        self.global_batch = global_batch
+        self.fetcher = _ArrivalFetcher()
+        self.sync_every = max(1, min(display_every, 16))
+        # flow-control bound on in-flight steps, so real-data runs don't
+        # stack an unbounded queue of host->device batch transfers in HBM
+        self.max_inflight = max(32, 2 * self.sync_every)
+
+    def start(self, handle) -> None:
+        """Stamp t=0 with an already-fetched (cheap) marker handle.
+
+        Blocks until the marker's arrival is recorded — otherwise a fast
+        first window could coalesce over it and the timeline would lose
+        its origin."""
+        self.fetcher.put(0, handle)
+        while not self.fetcher.arrivals:
+            self.fetcher.check()
+            time.sleep(1e-4)
+
+    def record(self, i: int, handle) -> None:
+        """Per-iteration bookkeeping: marker puts + flow control."""
+        if (i % self.sync_every == 0 or i % self.display_every == 0
+                or i == self.num_batches):
+            self.fetcher.put(i, handle)
+        while i - self.fetcher.fetched_step > self.max_inflight:
+            time.sleep(2e-3)
+        self.fetcher.check()
+
+    def finish(self, line_fn) -> tuple[float, list[float]]:
+        """Drain; call ``line_fn(step, rate, value)`` per display step in
+        order; return (total_time_s, per-window mean step times)."""
+        arrivals = self.fetcher.finish()
+        values = {i: v for i, _, v in arrivals}
+        if self.fetcher.skipped:    # everything is complete: cheap fetches
+            got = jax.device_get([h for _, h in self.fetcher.skipped])
+            values.update(
+                {i: v for (i, _), v in zip(self.fetcher.skipped, got)})
+        timed = {i: t for i, t, _ in arrivals}
+        t0 = arrivals[0][1]
+        total_time = arrivals[-1][1] - t0
+        window_times: list[float] = []
+        prev_i, prev_t = 0, t0
+        pending: list[int] = []
+        for i in range(1, self.num_batches + 1):
+            if not (i % self.display_every == 0 or i == self.num_batches):
+                continue
+            pending.append(i)
+            if i in timed:
+                dt = max((timed[i] - prev_t) / (i - prev_i), 1e-9)
+                for j in pending:
+                    line_fn(j, self.global_batch / dt, values.get(j))
+                window_times.append(dt)
+                prev_i, prev_t = i, timed[i]
+                pending = []
+        return total_time, window_times
+
+
 def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
               fab, print_fn):
     """tf_cnn_benchmarks --eval: timed forward passes + top-1 accuracy."""
@@ -147,35 +238,25 @@ def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
         loss, correct = eval_step(state, next(batch_iter))
     drain(loss)
 
-    # async dispatch with a background fetcher observing progress — same
-    # tunnel-safe timing protocol as the train loop (_ArrivalFetcher);
+    # async dispatch with the shared tunnel-safe protocol (_AsyncTimeline);
     # per-step correct counts are fetched in one transfer at the end
     corrects = []
-    fetcher = _ArrivalFetcher()
-    sync_every = max(1, min(cfg.display_every, 16))
-    max_inflight = max(32, 2 * sync_every)
-    fetcher.put(0, loss)        # drained above: arrival stamps t=0
+    timeline = _AsyncTimeline(cfg.num_batches, cfg.display_every,
+                              global_batch)
+    timeline.start(loss)        # drained above: arrival stamps t=0
     for i in range(1, cfg.num_batches + 1):
         loss, correct = eval_step(state, next(batch_iter))
         corrects.append(correct)
-        if (i % sync_every == 0 or i % cfg.display_every == 0
-                or i == cfg.num_batches):
-            fetcher.put(i, loss)
-        while i - fetcher.fetched_step > max_inflight:
-            time.sleep(2e-3)
-    arrivals = fetcher.finish()
-    total_time = arrivals[-1][1] - arrivals[0][1]
+        timeline.record(i, loss)
+    display_recs: list[tuple[int, float, object]] = []
+    total_time, window_times = timeline.finish(
+        lambda i, rate, v: display_recs.append((i, rate, v)))
     correct_np = np.asarray(jax.device_get(corrects))
     loss_vals = []
-    window_times = []
-    prev_i, prev_t = 0, arrivals[0][1]
-    for i, t, v in arrivals[1:]:
-        if i % cfg.display_every == 0 or i == cfg.num_batches:
-            top1 = float(correct_np[:i].sum()) / (i * global_batch)
-            loss_vals.append(float(np.asarray(v)))
-            window_times.append((t - prev_t) / (i - prev_i))
-            print_fn(f"{i}\ttop_1: {top1:.4f}\tloss: {loss_vals[-1]:.3f}")
-            prev_i, prev_t = i, t
+    for i, _, v in display_recs:
+        top1 = float(correct_np[:i].sum()) / (i * global_batch)
+        loss_vals.append(float(np.asarray(v)))
+        print_fn(f"{i}\ttop_1: {top1:.4f}\tloss: {loss_vals[-1]:.3f}")
     correct_total = float(correct_np.sum())
     seen = cfg.num_batches * global_batch
     total_rate = cfg.num_batches * global_batch / total_time
@@ -317,58 +398,32 @@ def run_benchmark(
 
     # --- timed loop (reference num_batches=100, display_every=10) ---
     # Fully asynchronous dispatch: the main thread never syncs, so the
-    # device never waits on a host/tunnel round trip.  A background
-    # fetcher observes progress (see _ArrivalFetcher); the already-
+    # device never waits on a host/tunnel round trip; progress is
+    # observed by the shared _AsyncTimeline protocol.  The already-
     # fetched warmup loss is the t=0 marker, so the measured span covers
     # exactly the num_batches timed steps.
     units = _example_units(cfg, spec)
-    fetcher = _ArrivalFetcher()
-    sync_every = max(1, min(cfg.display_every, 16))
-    # flow control: cap in-flight steps so real-data runs don't stack an
-    # unbounded queue of host->device batch transfers in HBM
-    max_inflight = max(32, 2 * sync_every)
-    losses: list[float] = []
-    window_times: list[float] = []
-    processed = 0
-    prev_i = 0
-    prev_t = None
-
-    def process_arrivals() -> None:
-        nonlocal processed, prev_i, prev_t
-        arrivals = fetcher.arrivals
-        while processed < len(arrivals):
-            i, t, v = arrivals[processed]
-            processed += 1
-            if i == 0:
-                prev_t = t
-                continue
-            if i % cfg.display_every == 0 or i == cfg.num_batches:
-                rate = (i - prev_i) * global_batch / (t - prev_t)
-                loss = float(np.asarray(v))
-                losses.append(loss)
-                window_times.append((t - prev_t) / (i - prev_i))
-                print_fn(f"{i}\t{units}/sec: {rate:.1f}\tloss: {loss:.3f}")
-                prev_i, prev_t = i, t
-
-    fetcher.put(0, metrics["loss"])     # drained above: arrival stamps t=0
+    timeline = _AsyncTimeline(cfg.num_batches, cfg.display_every,
+                              global_batch)
+    timeline.start(metrics["loss"])
     for i in range(1, cfg.num_batches + 1):
         state, metrics = train_step(state, next(batch_iter), rng)
-        if (i % sync_every == 0 or i % cfg.display_every == 0
-                or i == cfg.num_batches):
-            fetcher.put(i, metrics["loss"])
-        while i - fetcher.fetched_step > max_inflight:
-            time.sleep(2e-3)
-        if tracing and fetcher.fetched_step >= sync_every:
+        timeline.record(i, metrics["loss"])
+        if tracing and timeline.fetcher.fetched_step >= timeline.sync_every:
             jax.profiler.stop_trace()
             tracing = False
             print_fn(f"profiler trace written to {cfg.trace_dir}")
-        process_arrivals()
-    arrivals = fetcher.finish()
+    losses: list[float] = []
+
+    def line(i: int, rate: float, v) -> None:
+        loss = float(np.asarray(v))
+        losses.append(loss)
+        print_fn(f"{i}\t{units}/sec: {rate:.1f}\tloss: {loss:.3f}")
+
+    total_time, window_times = timeline.finish(line)
     if tracing:
         jax.profiler.stop_trace()
         print_fn(f"profiler trace written to {cfg.trace_dir}")
-    process_arrivals()
-    total_time = arrivals[-1][1] - arrivals[0][1]
     total_rate = cfg.num_batches * global_batch / total_time
     per_chip = total_rate / layout.total_workers
     mean_ms = 1e3 * total_time / cfg.num_batches

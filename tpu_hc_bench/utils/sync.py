@@ -52,8 +52,3 @@ def drain(tree):
     if probes:
         jax.device_get(probes)
     return tree
-
-
-def wait_ready(x) -> None:
-    """Wait for one array's computation to truly finish (one RTT)."""
-    drain(x)
