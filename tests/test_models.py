@@ -98,6 +98,32 @@ def test_googlenet_params_and_shape():
     assert out.shape == (1, 1000)
 
 
+def test_mobilenet_params_and_shape():
+    model, spec, variables, x = init_model("mobilenet")
+    count = n_params(variables["params"])
+    # MobileNet v1 1.0/224 ~4.2M
+    assert abs(count - 4.25e6) / 4.25e6 < 0.03, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+
+
+def test_densenet40_params_and_shape():
+    model, spec, variables, x = init_model("densenet40_k12", num_classes=10)
+    count = n_params(variables["params"])
+    # Huang 2017 table 2: DenseNet (k=12) depth 40 ~ 1.0M
+    assert abs(count - 1.0e6) / 1.0e6 < 0.1, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 10)
+
+
+@pytest.mark.parametrize("name", ["lenet", "overfeat", "densenet100_k12"])
+def test_small_zoo_forward(name):
+    model, spec, variables, x = init_model(
+        name, num_classes=10 if "densenet" in name else 1000)
+    out = model.apply(variables, x, train=False)
+    assert out.shape[0] == 1
+
+
 def test_bert_base_params():
     model = bert.BertMLM()
     x = jnp.zeros((1, 128), jnp.int32)

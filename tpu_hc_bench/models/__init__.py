@@ -46,13 +46,25 @@ class ModelSpec:
 
 def _registry() -> dict[str, ModelSpec]:
     from tpu_hc_bench.models import (
-        alexnet, bert, googlenet, inception, resnet, vgg,
+        alexnet, bert, densenet, googlenet, inception, mobilenet, resnet,
+        small_cnns, vgg,
     )
 
     specs = [
         ModelSpec("trivial", TrivialModel, (224, 224, 3), 2 * 150528 * 1000),
         ModelSpec("alexnet", alexnet.alexnet, (224, 224, 3), 1.43e9),
         ModelSpec("googlenet", googlenet.googlenet, (224, 224, 3), 3.0e9),
+        # forward FLOPs below are 2*MACs of the conv/FC layers at the
+        # canonical shape (same convention as the resnet figures)
+        ModelSpec("lenet", small_cnns.lenet, (28, 28, 3), 2.46e7,
+                  default_image_size=28),
+        ModelSpec("overfeat", small_cnns.overfeat, (231, 231, 3), 7.53e9,
+                  default_image_size=231),
+        ModelSpec("mobilenet", mobilenet.mobilenet, (224, 224, 3), 1.16e9),
+        ModelSpec("densenet40_k12", densenet.densenet40_k12, (32, 32, 3),
+                  5.08e8, default_image_size=32),
+        ModelSpec("densenet100_k12", densenet.densenet100_k12, (32, 32, 3),
+                  1.88e9, default_image_size=32),
         # ResNet fwd GFLOPs at 224^2 (2*MACs): v1.5 figures
         ModelSpec("resnet18", resnet.resnet18, (224, 224, 3), 3.64e9),
         ModelSpec("resnet34", resnet.resnet34, (224, 224, 3), 7.34e9),
@@ -77,6 +89,9 @@ _ALIASES = {
     "inception_v3": "inception3",
     "bert": "bert_base",
     "bert-base": "bert_base",
+    "lenet5": "lenet",
+    "densenet": "densenet40_k12",
+    "mobilenet_v1": "mobilenet",
 }
 
 

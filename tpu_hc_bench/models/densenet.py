@@ -1,0 +1,63 @@
+"""CIFAR DenseNet in Flax (tf_cnn_benchmarks zoo's densenet family).
+
+tf_cnn_benchmarks ships the CIFAR-scale DenseNets (Huang 2017) —
+densenet40-k12, densenet100-k12, densenet100-k24 — 32x32 inputs, three
+dense blocks of BN→relu→3x3conv layers with channel concatenation, 1x1
+conv + 2x2 avg-pool transitions, global-pool head.
+
+Concatenation-heavy graphs are bandwidth-shaped on TPU; XLA fuses the
+BN/relu chains into the convs, and the whole model is small enough that
+per-op overhead, not FLOPs, dominates — a useful stress of the framework's
+small-model path (the CNN analog of ``trivial``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DenseNetCifar(nn.Module):
+    depth: int = 40
+    growth: int = 12
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 padding="SAME")
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        layers_per_block = (self.depth - 4) // 3
+
+        x = x.astype(self.dtype)
+        x = conv(16, (3, 3), name="conv_init")(x)
+        for b in range(3):
+            for l in range(layers_per_block):
+                y = nn.relu(norm(name=f"b{b}_l{l}_bn")(x))
+                y = conv(self.growth, (3, 3), name=f"b{b}_l{l}_conv")(y)
+                x = jnp.concatenate([x, y], axis=-1)
+            if b < 2:   # transition: 1x1 conv, keep channels, then pool
+                x = nn.relu(norm(name=f"t{b}_bn")(x))
+                x = conv(x.shape[-1], (1, 1), name=f"t{b}_conv")(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(norm(name="bn_final")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def densenet40_k12(num_classes=10, dtype=jnp.float32):
+    return DenseNetCifar(depth=40, growth=12, num_classes=num_classes,
+                         dtype=dtype)
+
+
+def densenet100_k12(num_classes=10, dtype=jnp.float32):
+    return DenseNetCifar(depth=100, growth=12, num_classes=num_classes,
+                         dtype=dtype)

@@ -1,0 +1,58 @@
+"""MobileNet v1 in Flax (tf_cnn_benchmarks zoo's mobile family).
+
+Depthwise-separable CNN (Howard 2017) at the standard 1.0 width, 224x224.
+Depthwise convolutions are expressed with ``feature_group_count=channels``
+— XLA:TPU lowers these to VPU-friendly per-channel convs; the pointwise
+1x1s are plain MXU matmuls and carry nearly all the FLOPs.
+
+TPU conventions shared with the zoo: NHWC, parameterized compute dtype
+(params/BN stats fp32), local-batch BN (Horovod DP semantics — see
+``models/resnet.py`` module docstring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (pointwise output channels, stride of the depthwise stage)
+_V1_BLOCKS = [
+    (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+    (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+    (1024, 2), (1024, 1),
+]
+
+
+class MobileNetV1(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 padding="SAME")
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-3, dtype=self.dtype,
+        )
+
+        x = x.astype(self.dtype)
+        x = conv(32, (3, 3), strides=(2, 2), name="conv_init")(x)
+        x = nn.relu6(norm(name="bn_init")(x))
+        for i, (filters, stride) in enumerate(_V1_BLOCKS):
+            c_in = x.shape[-1]
+            x = conv(c_in, (3, 3), strides=(stride, stride),
+                     feature_group_count=c_in, name=f"dw_{i}")(x)
+            x = nn.relu6(norm(name=f"dw_bn_{i}")(x))
+            x = conv(filters, (1, 1), name=f"pw_{i}")(x)
+            x = nn.relu6(norm(name=f"pw_bn_{i}")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def mobilenet(num_classes=1000, dtype=jnp.float32):
+    return MobileNetV1(num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,79 @@
+"""LeNet and OverFeat in Flax (tf_cnn_benchmarks zoo members).
+
+The reference drives tf_cnn_benchmarks' full ``--model=`` zoo (the harness
+pins resnet50 at ``run-tf-sing-ucx-openmpi.sh:34`` but the driven CLI
+accepts every zoo member); these are the two classic small members:
+
+- ``lenet``: tf_cnn_benchmarks' lenet5 (two 5x5 conv/pool stages then a
+  512-wide FC), run at 28x28.
+- ``overfeat``: the OverFeat "fast" network (Sermanet 2014) as
+  tf_cnn_benchmarks sizes it — 231x231 input, 11x11 stride-4 conv1,
+  five conv stages, 3072/4096 FCs.
+
+Same TPU conventions as the rest of the zoo: NHWC, parameterized compute
+dtype with fp32 head, dropout active only in training.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype,
+                            name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype,
+                            name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc2")(x)
+        return x.astype(jnp.float32)
+
+
+class OverFeat(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(96, (11, 11), strides=(4, 4), padding="VALID",
+                            dtype=self.dtype, name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(256, (5, 5), padding="SAME", dtype=self.dtype,
+                            name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(512, (3, 3), padding="SAME", dtype=self.dtype,
+                            name="conv3")(x))
+        x = nn.relu(nn.Conv(1024, (3, 3), padding="SAME", dtype=self.dtype,
+                            name="conv4")(x))
+        x = nn.relu(nn.Conv(1024, (3, 3), padding="SAME", dtype=self.dtype,
+                            name="conv5")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(3072, dtype=self.dtype, name="fc6")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc7")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc8")(x)
+        return x.astype(jnp.float32)
+
+
+def lenet(num_classes=1000, dtype=jnp.float32):
+    return LeNet(num_classes=num_classes, dtype=dtype)
+
+
+def overfeat(num_classes=1000, dtype=jnp.float32):
+    return OverFeat(num_classes=num_classes, dtype=dtype)
