@@ -1,0 +1,102 @@
+"""Measure every zoo model on the local chip(s); emit one JSON line each.
+
+The reference records one number per (model, batch, fabric) run in a tee'd
+log (run-tf-sing-ucx-openmpi.sh:9-12); this sweep automates the matrix the
+way an operator would drive it, writing ``sweep_results.jsonl`` for
+BASELINE.md.  Usage:
+
+    python scripts/sweep_zoo.py [--out FILE] [--models a,b,c]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+# (model, per-chip batch) — batch chosen to fill HBM without OOM, mirroring
+# tf_cnn_benchmarks' per-model defaults where it has them.
+DEFAULT_MATRIX = [
+    ("trivial", 512),
+    ("lenet", 2048),
+    ("alexnet", 512),
+    ("overfeat", 256),
+    ("googlenet", 256),
+    ("mobilenet", 256),
+    ("densenet40_k12", 512),
+    ("densenet100_k12", 256),
+    ("resnet18", 256),
+    ("resnet34", 256),
+    ("resnet50", 128),
+    ("resnet101", 128),
+    ("resnet152", 64),
+    ("resnet50_v2", 128),
+    ("resnet101_v2", 128),
+    ("resnet152_v2", 64),
+    ("resnet20_cifar", 1024),
+    ("resnet56_cifar", 512),
+    ("resnet110_cifar", 256),
+    ("vgg11", 128),
+    ("vgg16", 128),
+    ("vgg19", 128),
+    ("inception3", 128),
+    ("inception4", 64),
+    ("bert_base", 128),
+]
+
+
+def run_one(model: str, batch: int, warmup: int, batches: int) -> dict:
+    cmd = [
+        sys.executable, "-m", "tpu_hc_bench", "1", "0", str(batch), "ici",
+        f"--model={model}", "--use_fp16=True",
+        f"--num_warmup_batches={warmup}", f"--num_batches={batches}",
+    ]
+    t0 = time.time()
+    rec: dict = {"model": model, "batch_size": batch}
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800)
+    except subprocess.TimeoutExpired:
+        rec.update(wall_s=round(time.time() - t0, 1), error="timeout")
+        return rec
+    out = proc.stdout + proc.stderr
+    rec["wall_s"] = round(time.time() - t0, 1)
+    if proc.returncode != 0:
+        rec["error"] = out.strip().splitlines()[-1] if out.strip() else "?"
+        return rec
+    for line in out.splitlines():
+        if line.startswith("images/sec/chip:") or "examples/sec/chip" in line:
+            # "images/sec/chip: X  step: Yms (p50 Zms)  MFU: W%"
+            parts = line.replace("%", "").split()
+            rec["per_chip"] = float(parts[1])
+            rec["step_ms"] = float(parts[3].rstrip("ms"))
+            rec["mfu_pct"] = float(parts[-1])
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="sweep_results.jsonl")
+    ap.add_argument("--models", default=None,
+                    help="comma list; default = full matrix")
+    ap.add_argument("--warmup", type=int, default=25)
+    ap.add_argument("--batches", type=int, default=60)
+    args = ap.parse_args()
+
+    matrix = DEFAULT_MATRIX
+    if args.models:
+        wanted = set(args.models.split(","))
+        matrix = [(m, b) for m, b in DEFAULT_MATRIX if m in wanted]
+
+    with open(args.out, "a") as f:
+        for model, batch in matrix:
+            rec = run_one(model, batch, args.warmup, args.batches)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,40 @@ def test_googlenet_params_and_shape():
     assert out.shape == (1, 1000)
 
 
+def test_resnet50_v2_params_and_shape():
+    model, spec, variables, x = init_model("resnet50_v2")
+    count = n_params(variables["params"])
+    # preact v2 carries the same conv stack as v1 (~25.5M)
+    assert abs(count - 25.5e6) / 25.5e6 < 0.01, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+
+
+def test_cifar_resnet_params():
+    # He 2015 §4.2: 0.27M / 0.85M / 1.7M for depths 20 / 56 / 110
+    for name, want in [("resnet20", 0.27e6), ("resnet56", 0.85e6),
+                       ("resnet110", 1.7e6)]:
+        _, spec, variables, _ = init_model(name, num_classes=10)
+        assert spec.name == f"{name}_cifar"
+        count = n_params(variables["params"])
+        assert abs(count - want) / want < 0.03, (name, count)
+
+
+def test_vgg11_params():
+    _, _, variables, _ = init_model("vgg11")
+    count = n_params(variables["params"])
+    assert abs(count - 132.9e6) / 132.9e6 < 0.01, count
+
+
+def test_inception4_params_and_shape():
+    model, spec, variables, x = init_model("inception4")
+    count = n_params(variables["params"])
+    # Szegedy 2016: ~42.7M (no aux head)
+    assert abs(count - 42.7e6) / 42.7e6 < 0.02, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+
+
 def test_mobilenet_params_and_shape():
     model, spec, variables, x = init_model("mobilenet")
     count = n_params(variables["params"])
@@ -122,6 +156,48 @@ def test_small_zoo_forward(name):
         name, num_classes=10 if "densenet" in name else 1000)
     out = model.apply(variables, x, train=False)
     assert out.shape[0] == 1
+
+
+def test_space_to_depth_stem_equivalence():
+    """The packed 4x4/s1 stem computes exactly the 7x7/s2 SAME conv.
+
+    Maps a 7x7 kernel (zero-padded to 8x8) into the packed layout
+    K[r,s,py*2c+px*c+ch,f] = W8[2r+py,2s+px,ch,f] and checks outputs match.
+    """
+    import jax.lax as lax
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (2, 16, 16, 3))
+    w = jax.random.normal(jax.random.PRNGKey(1), (7, 7, 3, 8))
+    ref = lax.conv_general_dilated(
+        x, w, window_strides=(2, 2), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    n, h, wd, c = x.shape
+    xp = x.reshape(n, h // 2, 2, wd // 2, 2, c)
+    xp = xp.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, wd // 2, 4 * c)
+    w8 = jnp.zeros((8, 8, 3, 8)).at[:7, :7].set(w)
+    kp = jnp.zeros((4, 4, 12, 8))
+    for py in range(2):
+        for px in range(2):
+            for ch in range(3):
+                kp = kp.at[:, :, py * 6 + px * 3 + ch, :].set(
+                    w8[py::2, px::2, ch, :])
+    out = lax.conv_general_dilated(
+        xp, kp, window_strides=(1, 1), padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_resnet_s2d_forward():
+    model, spec = models.create_model("resnet18", space_to_depth=True)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert variables["params"]["conv_init_s2d"]["kernel"].shape == (4, 4, 12, 64)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+    with pytest.raises(ValueError):
+        models.create_model("mobilenet", space_to_depth=True)
 
 
 def test_bert_base_params():

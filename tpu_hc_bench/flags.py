@@ -117,6 +117,8 @@ class BenchmarkConfig:
                                               # reference's I_MPI_DEBUG tracing
     fused_xent: bool = False                  # Pallas blocked cross-entropy
                                               # for large-vocab (MLM) heads
+    use_space_to_depth: bool = False          # ResNet stem as 4x4/s1 conv on
+                                              # 2x2-packed input (MXU-friendly)
     attention_impl: str = "dense"             # dense|flash: transformer
                                               # attention kernel (flash =
                                               # Pallas blocked softmax)
@@ -219,6 +221,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--trace_dir", type=str, default=None)
     p.add_argument("--fused_xent", type=_parse_bool, default=False)
+    p.add_argument("--use_space_to_depth", type=_parse_bool,
+                   default=d.use_space_to_depth)
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"])
     return p

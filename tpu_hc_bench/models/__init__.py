@@ -42,12 +42,13 @@ class ModelSpec:
     flops_per_example: float           # forward FLOPs at input_shape
     is_text: bool = False
     default_image_size: int = 224
+    supports_s2d: bool = False         # stem accepts space_to_depth=True
 
 
 def _registry() -> dict[str, ModelSpec]:
     from tpu_hc_bench.models import (
-        alexnet, bert, densenet, googlenet, inception, mobilenet, resnet,
-        small_cnns, vgg,
+        alexnet, bert, cifar_resnet, densenet, googlenet, inception,
+        mobilenet, resnet, small_cnns, vgg,
     )
 
     specs = [
@@ -66,14 +67,40 @@ def _registry() -> dict[str, ModelSpec]:
         ModelSpec("densenet100_k12", densenet.densenet100_k12, (32, 32, 3),
                   1.88e9, default_image_size=32),
         # ResNet fwd GFLOPs at 224^2 (2*MACs): v1.5 figures
-        ModelSpec("resnet18", resnet.resnet18, (224, 224, 3), 3.64e9),
-        ModelSpec("resnet34", resnet.resnet34, (224, 224, 3), 7.34e9),
-        ModelSpec("resnet50", resnet.resnet50, (224, 224, 3), 8.2e9),
-        ModelSpec("resnet101", resnet.resnet101, (224, 224, 3), 15.7e9),
-        ModelSpec("resnet152", resnet.resnet152, (224, 224, 3), 23.1e9),
+        ModelSpec("resnet18", resnet.resnet18, (224, 224, 3), 3.64e9,
+                  supports_s2d=True),
+        ModelSpec("resnet34", resnet.resnet34, (224, 224, 3), 7.34e9,
+                  supports_s2d=True),
+        ModelSpec("resnet50", resnet.resnet50, (224, 224, 3), 8.2e9,
+                  supports_s2d=True),
+        ModelSpec("resnet101", resnet.resnet101, (224, 224, 3), 15.7e9,
+                  supports_s2d=True),
+        ModelSpec("resnet152", resnet.resnet152, (224, 224, 3), 23.1e9,
+                  supports_s2d=True),
+        # v2 (full preactivation) — same conv stack, same 2*MAC figures
+        ModelSpec("resnet50_v2", resnet.resnet50_v2, (224, 224, 3), 8.2e9,
+                  supports_s2d=True),
+        ModelSpec("resnet101_v2", resnet.resnet101_v2, (224, 224, 3), 15.7e9,
+                  supports_s2d=True),
+        ModelSpec("resnet152_v2", resnet.resnet152_v2, (224, 224, 3), 23.1e9,
+                  supports_s2d=True),
+        # CIFAR 6n+2 family (He 2015 §4.2), 32x32
+        ModelSpec("resnet20_cifar", cifar_resnet.resnet20_cifar, (32, 32, 3),
+                  8.2e7, default_image_size=32),
+        ModelSpec("resnet32_cifar", cifar_resnet.resnet32_cifar, (32, 32, 3),
+                  1.4e8, default_image_size=32),
+        ModelSpec("resnet44_cifar", cifar_resnet.resnet44_cifar, (32, 32, 3),
+                  1.9e8, default_image_size=32),
+        ModelSpec("resnet56_cifar", cifar_resnet.resnet56_cifar, (32, 32, 3),
+                  2.5e8, default_image_size=32),
+        ModelSpec("resnet110_cifar", cifar_resnet.resnet110_cifar, (32, 32, 3),
+                  5.1e8, default_image_size=32),
+        ModelSpec("vgg11", vgg.vgg11, (224, 224, 3), 15.2e9),
         ModelSpec("vgg16", vgg.vgg16, (224, 224, 3), 30.9e9),
         ModelSpec("vgg19", vgg.vgg19, (224, 224, 3), 39.3e9),
         ModelSpec("inception3", inception.inception_v3, (299, 299, 3), 11.4e9,
+                  default_image_size=299),
+        ModelSpec("inception4", inception.inception_v4, (299, 299, 3), 24.5e9,
                   default_image_size=299),
         ModelSpec("bert_base", bert.bert_base_mlm, (128,), 2 * 110e6 * 128,
                   is_text=True),
@@ -92,6 +119,13 @@ _ALIASES = {
     "lenet5": "lenet",
     "densenet": "densenet40_k12",
     "mobilenet_v1": "mobilenet",
+    "inception_v4": "inception4",
+    # tf_cnn_benchmarks names the CIFAR family bare resnet<depth>
+    "resnet20": "resnet20_cifar",
+    "resnet32": "resnet32_cifar",
+    "resnet44": "resnet44_cifar",
+    "resnet56": "resnet56_cifar",
+    "resnet110": "resnet110_cifar",
 }
 
 
@@ -108,9 +142,13 @@ def list_models() -> list[str]:
 
 
 def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
-                 attention_impl: str = "dense"):
+                 attention_impl: str = "dense", space_to_depth: bool = False):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
     if spec.is_text:   # attention kernel choice only exists for transformers
         kwargs["attention_impl"] = attention_impl
+    if spec.supports_s2d:
+        kwargs["space_to_depth"] = space_to_depth
+    elif space_to_depth:
+        raise ValueError(f"--use_space_to_depth: {name} has no s2d stem")
     return spec.create(**kwargs), spec

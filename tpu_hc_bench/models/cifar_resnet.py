@@ -1,0 +1,64 @@
+"""CIFAR ResNets (He 2015 §4.2) — tf_cnn_benchmarks' resnet20..110 family.
+
+The depth-6n+2 networks for 32x32 inputs: a 3x3/16 stem, three stages of n
+basic blocks at 16/32/64 filters (stride 2 between stages), global pool,
+10-way head.  Reuses the ImageNet family's ``BasicBlock`` (models/resnet.py)
+— same NHWC/bf16/local-batch-BN conventions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpu_hc_bench.models.resnet import BasicBlock
+
+
+class CifarResNet(nn.Module):
+    stage_sizes: Sequence[int]          # n blocks per stage, 3 stages
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        x = act(norm(name="bn_init")(conv(16, (3, 3), name="conv_init")(x)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BasicBlock(
+                    filters=16 * 2**i, strides=strides,
+                    conv=conv, norm=norm, act=act,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def _make(depth):
+    n = (depth - 2) // 6
+
+    def create(num_classes=10, dtype=jnp.float32):
+        return CifarResNet([n, n, n], num_classes=num_classes, dtype=dtype)
+
+    create.__name__ = f"resnet{depth}_cifar"
+    return create
+
+
+resnet20_cifar = _make(20)
+resnet32_cifar = _make(32)
+resnet44_cifar = _make(44)
+resnet56_cifar = _make(56)
+resnet110_cifar = _make(110)

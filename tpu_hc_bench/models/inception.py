@@ -1,4 +1,4 @@
-"""Inception-v3 in Flax (BASELINE.json config 3; tf_cnn_benchmarks `inception3`).
+"""Inception-v3/v4 in Flax (BASELINE.json config 3; tf_cnn_benchmarks `inception3`/`inception4`).
 
 Standard Inception-v3 (Szegedy et al. 2015) at 299x299 NHWC: stem, 3x
 InceptionA (35x35), grid reduction B, 4x InceptionC (17x17), reduction D,
@@ -162,3 +162,146 @@ class InceptionV3(nn.Module):
 
 def inception_v3(num_classes=1000, dtype=jnp.float32):
     return InceptionV3(num_classes=num_classes, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Inception-v4 (Szegedy et al. 2016) — tf_cnn_benchmarks `inception4`.
+# Same ConvBN building block; pure-Inception variant (no residuals), 299x299.
+# ---------------------------------------------------------------------------
+
+
+class StemV4(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = c(32, (3, 3), padding="VALID")(x, train)
+        x = c(64, (3, 3))(x, train)
+        x = jnp.concatenate([
+            nn.max_pool(x, (3, 3), strides=(2, 2)),
+            c(96, (3, 3), strides=(2, 2), padding="VALID")(x, train),
+        ], axis=-1)
+        b1 = c(96, (3, 3), padding="VALID")(c(64, (1, 1))(x, train), train)
+        b2 = c(64, (1, 1))(x, train)
+        b2 = c(64, (1, 7))(b2, train)
+        b2 = c(64, (7, 1))(b2, train)
+        b2 = c(96, (3, 3), padding="VALID")(b2, train)
+        x = jnp.concatenate([b1, b2], axis=-1)
+        return jnp.concatenate([
+            c(192, (3, 3), strides=(2, 2), padding="VALID")(x, train),
+            nn.max_pool(x, (3, 3), strides=(2, 2)),
+        ], axis=-1)                     # 35x35x384
+
+
+class InceptionA4(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(96, (1, 1))(x, train)
+        b2 = c(96, (3, 3))(c(64, (1, 1))(x, train), train)
+        b3 = c(96, (3, 3))(c(96, (3, 3))(c(64, (1, 1))(x, train), train), train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(96, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA4(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = c(192, (1, 1))(x, train)
+        b2 = c(224, (3, 3))(b2, train)
+        b2 = c(256, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)   # 17x17x1024
+
+
+class InceptionB4(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(384, (1, 1))(x, train)
+        b2 = c(192, (1, 1))(x, train)
+        b2 = c(224, (1, 7))(b2, train)
+        b2 = c(256, (7, 1))(b2, train)
+        b3 = c(192, (1, 1))(x, train)
+        b3 = c(192, (7, 1))(b3, train)
+        b3 = c(224, (1, 7))(b3, train)
+        b3 = c(224, (7, 1))(b3, train)
+        b3 = c(256, (1, 7))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(128, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB4(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(192, (1, 1))(x, train)
+        b1 = c(192, (3, 3), strides=(2, 2), padding="VALID")(b1, train)
+        b2 = c(256, (1, 1))(x, train)
+        b2 = c(256, (1, 7))(b2, train)
+        b2 = c(320, (7, 1))(b2, train)
+        b2 = c(320, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)   # 8x8x1536
+
+
+class InceptionC4(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(256, (1, 1))(x, train)
+        b2 = c(384, (1, 1))(x, train)
+        b2 = jnp.concatenate(
+            [c(256, (1, 3))(b2, train), c(256, (3, 1))(b2, train)], axis=-1
+        )
+        b3 = c(384, (1, 1))(x, train)
+        b3 = c(448, (1, 3))(b3, train)
+        b3 = c(512, (3, 1))(b3, train)
+        b3 = jnp.concatenate(
+            [c(256, (3, 1))(b3, train), c(256, (1, 3))(b3, train)], axis=-1
+        )
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(256, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV4(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = StemV4(dtype=self.dtype)(x, train)
+        for _ in range(4):
+            x = InceptionA4(dtype=self.dtype)(x, train)
+        x = ReductionA4(dtype=self.dtype)(x, train)
+        for _ in range(7):
+            x = InceptionB4(dtype=self.dtype)(x, train)
+        x = ReductionB4(dtype=self.dtype)(x, train)
+        for _ in range(3):
+            x = InceptionC4(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def inception_v4(num_classes=1000, dtype=jnp.float32):
+    return InceptionV4(num_classes=num_classes, dtype=dtype)

@@ -81,6 +81,36 @@ class BasicBlock(nn.Module):
         return self.act(residual + y)
 
 
+class PreactBottleneckBlock(nn.Module):
+    """ResNet-v2 bottleneck (He 2016 full preactivation): BN-relu precede
+    every conv, identity carries no norm/act.  tf_cnn_benchmarks exposes
+    these as ``resnet50_v2``/``101_v2``/``152_v2``."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        preact = self.act(self.norm()(x))
+        out_ch = self.filters * 4
+        if x.shape[-1] != out_ch or self.strides != 1:
+            residual = self.conv(
+                out_ch, (1, 1), strides=(self.strides, self.strides),
+                name="shortcut_conv",
+            )(preact)
+        else:
+            residual = x
+        y = self.conv(self.filters, (1, 1))(preact)
+        y = self.act(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.act(self.norm()(y))
+        y = self.conv(out_ch, (1, 1))(y)
+        return residual + y
+
+
 class ResNet(nn.Module):
     """ImageNet ResNet, NHWC, parameterized depth and dtype."""
 
@@ -89,6 +119,11 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.float32
+    preact: bool = False                # v2: BN-relu inside blocks only
+    space_to_depth: bool = False        # pack 2x2 blocks into channels and
+                                        # run the stem as a 4x4/s1 conv — the
+                                        # standard TPU stem transform (3-ch
+                                        # 7x7/s2 convs map poorly to the MXU)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -105,9 +140,25 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), strides=(2, 2), name="conv_init")(x)
-        x = norm(name="bn_init")(x)
-        x = act(x)
+        if self.space_to_depth:
+            # [N, 2h, 2w, c] -> [N, h, w, 4c]; the 7x7/s2 stem conv becomes a
+            # 4x4/s1 conv over the packed image whose kernel rows/cols
+            # interleave the (zero-padded-to-8x8) 7x7 weights.  Same math,
+            # one quarter the spatial positions, 4x the contraction depth.
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+            # padding ((1,2),(1,2)) in packed space reproduces SAME padding
+            # (2 before, 3 after) of the 7x7/s2 conv at even input sizes
+            x = conv(
+                self.num_filters, (4, 4), padding=((1, 2), (1, 2)),
+                name="conv_init_s2d",
+            )(x)
+        else:
+            x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                     name="conv_init")(x)
+        if not self.preact:
+            x = act(norm(name="bn_init")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
@@ -119,26 +170,25 @@ class ResNet(nn.Module):
                     norm=norm,
                     act=act,
                 )(x)
+        if self.preact:
+            x = act(norm(name="bn_final")(x))
         x = jnp.mean(x, axis=(1, 2))  # global average pool over H,W
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x.astype(jnp.float32)
 
 
-def resnet18(num_classes=1000, dtype=jnp.float32):
-    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes, dtype=dtype)
+def _family(stages, block, preact=False):
+    def create(num_classes=1000, dtype=jnp.float32, space_to_depth=False):
+        return ResNet(stages, block, num_classes=num_classes, dtype=dtype,
+                      preact=preact, space_to_depth=space_to_depth)
+    return create
 
 
-def resnet34(num_classes=1000, dtype=jnp.float32):
-    return ResNet([3, 4, 6, 3], BasicBlock, num_classes=num_classes, dtype=dtype)
-
-
-def resnet50(num_classes=1000, dtype=jnp.float32):
-    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
-
-
-def resnet101(num_classes=1000, dtype=jnp.float32):
-    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
-
-
-def resnet152(num_classes=1000, dtype=jnp.float32):
-    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
+resnet18 = _family([2, 2, 2, 2], BasicBlock)
+resnet34 = _family([3, 4, 6, 3], BasicBlock)
+resnet50 = _family([3, 4, 6, 3], BottleneckBlock)
+resnet101 = _family([3, 4, 23, 3], BottleneckBlock)
+resnet152 = _family([3, 8, 36, 3], BottleneckBlock)
+resnet50_v2 = _family([3, 4, 6, 3], PreactBottleneckBlock, preact=True)
+resnet101_v2 = _family([3, 4, 23, 3], PreactBottleneckBlock, preact=True)
+resnet152_v2 = _family([3, 8, 36, 3], PreactBottleneckBlock, preact=True)

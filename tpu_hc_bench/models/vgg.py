@@ -1,4 +1,4 @@
-"""VGG-16/19 in Flax (BASELINE.json config 3: "Inception-v3 / VGG-16 sweep").
+"""VGG-11/16/19 in Flax (BASELINE.json config 3: "Inception-v3 / VGG-16 sweep").
 
 Classic VGG (Simonyan & Zisserman) as driven by tf_cnn_benchmarks: conv
 stacks without batch norm, two 4096-unit FC layers, NHWC.  Fresh TPU-first
@@ -37,6 +37,10 @@ class VGG(nn.Module):
         x = nn.Dropout(0.5, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc8")(x)
         return x.astype(jnp.float32)
+
+
+def vgg11(num_classes=1000, dtype=jnp.float32):
+    return VGG([1, 1, 2, 2, 2], num_classes=num_classes, dtype=dtype)
 
 
 def vgg16(num_classes=1000, dtype=jnp.float32):
