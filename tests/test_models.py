@@ -20,11 +20,15 @@ def n_params(tree):
 
 
 def init_model(name, image=None, num_classes=1000):
+    """Init at the canonical shape, or ``image`` px for global-pool models
+    (their param count is input-size independent; small inits keep the CPU
+    suite fast)."""
     model, spec = models.create_model(name, num_classes=num_classes)
     if spec.is_text:
         x = jnp.zeros((1, *spec.input_shape), jnp.int32)
     else:
-        x = jnp.zeros((1, *spec.input_shape), jnp.float32)
+        size = image or spec.default_image_size
+        x = jnp.zeros((1, size, size, spec.input_shape[-1]), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     return model, spec, variables, x
 
@@ -51,7 +55,7 @@ def test_trivial_forward():
 
 
 def test_resnet50_params_and_shape():
-    model, spec, variables, x = init_model("resnet50")
+    model, spec, variables, x = init_model("resnet50", image=64)
     count = n_params(variables["params"])
     assert abs(count - 25.6e6) / 25.6e6 < 0.01, count
     out = model.apply(variables, x, train=False)
@@ -60,7 +64,7 @@ def test_resnet50_params_and_shape():
 
 
 def test_resnet18_params():
-    _, _, variables, _ = init_model("resnet18")
+    _, _, variables, _ = init_model("resnet18", image=64)
     count = n_params(variables["params"])
     assert abs(count - 11.7e6) / 11.7e6 < 0.02, count
 
@@ -72,7 +76,7 @@ def test_vgg16_params():
 
 
 def test_inception3_params_and_shape():
-    model, spec, variables, x = init_model("inception3")
+    model, spec, variables, x = init_model("inception3", image=96)
     count = n_params(variables["params"])
     # canonical inception_v3 (no aux head) is ~23.8M
     assert abs(count - 23.8e6) / 23.8e6 < 0.03, count
@@ -90,7 +94,7 @@ def test_alexnet_params_and_shape():
 
 
 def test_googlenet_params_and_shape():
-    model, spec, variables, x = init_model("googlenet")
+    model, spec, variables, x = init_model("googlenet", image=64)
     count = n_params(variables["params"])
     # GoogLeNet ~6.6M (no aux heads)
     assert abs(count - 6.6e6) / 6.6e6 < 0.1, count
@@ -99,7 +103,7 @@ def test_googlenet_params_and_shape():
 
 
 def test_resnet50_v2_params_and_shape():
-    model, spec, variables, x = init_model("resnet50_v2")
+    model, spec, variables, x = init_model("resnet50_v2", image=64)
     count = n_params(variables["params"])
     # preact v2 carries the same conv stack as v1 (~25.5M)
     assert abs(count - 25.5e6) / 25.5e6 < 0.01, count
@@ -124,7 +128,7 @@ def test_vgg11_params():
 
 
 def test_inception4_params_and_shape():
-    model, spec, variables, x = init_model("inception4")
+    model, spec, variables, x = init_model("inception4", image=160)
     count = n_params(variables["params"])
     # Szegedy 2016: ~42.7M (no aux head)
     assert abs(count - 42.7e6) / 42.7e6 < 0.02, count
@@ -133,7 +137,7 @@ def test_inception4_params_and_shape():
 
 
 def test_mobilenet_params_and_shape():
-    model, spec, variables, x = init_model("mobilenet")
+    model, spec, variables, x = init_model("mobilenet", image=64)
     count = n_params(variables["params"])
     # MobileNet v1 1.0/224 ~4.2M
     assert abs(count - 4.25e6) / 4.25e6 < 0.03, count
@@ -211,6 +215,15 @@ def test_bert_base_params():
     assert out.shape == (1, 128, bert.BERT_BASE_VOCAB)
 
 
+def test_bert_large_params():
+    model = bert.bert_large_mlm()
+    x = jnp.zeros((1, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    count = n_params(variables["params"])
+    # BERT-large ~335M with tied MLM projection
+    assert 320e6 < count < 350e6, count
+
+
 def test_bert_tiny_forward_train_mode():
     model = bert.bert_tiny_mlm()
     x = jnp.zeros((2, 16), jnp.int32)
@@ -219,6 +232,19 @@ def test_bert_tiny_forward_train_mode():
         variables, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)}
     )
     assert out.shape == (2, 16, 1024)
+
+
+def test_seq_len_override():
+    model, spec = models.create_model("bert_tiny", seq_len=256)
+    assert spec.input_shape == (256,)
+    # linear rescale from the registry's seq-64 figure
+    assert spec.flops_per_example == pytest.approx(2 * 4.5e6 * 64 * 4)
+    x = jnp.zeros((1, 256), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 256, 1024)
+    with pytest.raises(ValueError):
+        models.create_model("resnet18", seq_len=256)
 
 
 def test_bf16_compute_keeps_fp32_params_and_logits():

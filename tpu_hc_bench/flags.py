@@ -119,6 +119,9 @@ class BenchmarkConfig:
                                               # for large-vocab (MLM) heads
     use_space_to_depth: bool = False          # ResNet stem as 4x4/s1 conv on
                                               # 2x2-packed input (MXU-friendly)
+    seq_len: int | None = None                # text models: override the
+                                              # registry sequence length
+                                              # (long-context runs)
     attention_impl: str = "dense"             # dense|flash: transformer
                                               # attention kernel (flash =
                                               # Pallas blocked softmax)
@@ -223,6 +226,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused_xent", type=_parse_bool, default=False)
     p.add_argument("--use_space_to_depth", type=_parse_bool,
                    default=d.use_space_to_depth)
+    p.add_argument("--seq_len", type=int, default=d.seq_len)
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"])
     return p

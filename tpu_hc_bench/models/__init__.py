@@ -104,6 +104,8 @@ def _registry() -> dict[str, ModelSpec]:
                   default_image_size=299),
         ModelSpec("bert_base", bert.bert_base_mlm, (128,), 2 * 110e6 * 128,
                   is_text=True),
+        ModelSpec("bert_large", bert.bert_large_mlm, (128,), 2 * 335e6 * 128,
+                  is_text=True),
         # ~4.5M params, seq 64: CPU-smoke/test variant of the MLM path
         ModelSpec("bert_tiny", bert.bert_tiny_mlm, (64,), 2 * 4.5e6 * 64,
                   is_text=True),
@@ -142,11 +144,24 @@ def list_models() -> list[str]:
 
 
 def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
-                 attention_impl: str = "dense", space_to_depth: bool = False):
+                 attention_impl: str = "dense", space_to_depth: bool = False,
+                 seq_len: int | None = None):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
     if spec.is_text:   # attention kernel choice only exists for transformers
         kwargs["attention_impl"] = attention_impl
+        if seq_len is not None:
+            # long-context override: rescale the linear-in-seq FLOP figure
+            # (conservative — ignores the quadratic attention term); the
+            # factory grows its position table only if seq_len demands it
+            kwargs["max_len"] = seq_len
+            spec = dataclasses.replace(
+                spec, input_shape=(seq_len,),
+                flops_per_example=spec.flops_per_example
+                * seq_len / spec.input_shape[0],
+            )
+    elif seq_len is not None:
+        raise ValueError(f"--seq_len only applies to text models, not {name}")
     if spec.supports_s2d:
         kwargs["space_to_depth"] = space_to_depth
     elif space_to_depth:

@@ -298,7 +298,8 @@ def run_benchmark(
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, attention_impl=cfg.attention_impl,
-                               space_to_depth=cfg.use_space_to_depth)
+                               space_to_depth=cfg.use_space_to_depth,
+                               seq_len=cfg.seq_len)
 
     # --- banner (reference :52-58 config echo) ---
     for line in layout.summary_lines(fabric=fab.value):
