@@ -145,6 +145,22 @@ def test_mobilenet_params_and_shape():
     assert out.shape == (1, 1000)
 
 
+def test_nasnet_mobile_params_and_shape():
+    model, spec, variables, x = init_model("nasnet", image=96)
+    count = n_params(variables["params"])
+    # NASNet-A mobile (4 @ 1056) ~5.3M
+    assert abs(count - 5.3e6) / 5.3e6 < 0.02, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+
+
+def test_nasnetlarge_params():
+    _, _, variables, _ = init_model("nasnetlarge", image=96)
+    count = n_params(variables["params"])
+    # NASNet-A large (6 @ 4032) ~88.9M
+    assert abs(count - 88.9e6) / 88.9e6 < 0.01, count
+
+
 def test_densenet40_params_and_shape():
     model, spec, variables, x = init_model("densenet40_k12", num_classes=10)
     count = n_params(variables["params"])

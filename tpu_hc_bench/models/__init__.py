@@ -48,7 +48,7 @@ class ModelSpec:
 def _registry() -> dict[str, ModelSpec]:
     from tpu_hc_bench.models import (
         alexnet, bert, cifar_resnet, densenet, googlenet, inception,
-        mobilenet, resnet, small_cnns, vgg,
+        mobilenet, nasnet, resnet, small_cnns, vgg,
     )
 
     specs = [
@@ -62,6 +62,10 @@ def _registry() -> dict[str, ModelSpec]:
         ModelSpec("overfeat", small_cnns.overfeat, (231, 231, 3), 7.53e9,
                   default_image_size=231),
         ModelSpec("mobilenet", mobilenet.mobilenet, (224, 224, 3), 1.16e9),
+        # NASNet-A: 2*MACs — mobile 564M, large 23.8B multiply-adds
+        ModelSpec("nasnet", nasnet.nasnet, (224, 224, 3), 1.13e9),
+        ModelSpec("nasnetlarge", nasnet.nasnetlarge, (331, 331, 3), 4.76e10,
+                  default_image_size=331),
         ModelSpec("densenet40_k12", densenet.densenet40_k12, (32, 32, 3),
                   5.08e8, default_image_size=32),
         ModelSpec("densenet100_k12", densenet.densenet100_k12, (32, 32, 3),

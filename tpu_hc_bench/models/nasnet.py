@@ -1,0 +1,193 @@
+"""NASNet-A in Flax (tf_cnn_benchmarks zoo's `nasnet`/`nasnetlarge`).
+
+NASNet-A (Zoph et al. 2018) from the paper's cell spec: a learned normal
+cell (6-branch concat) and reduction cell (4-branch concat) stacked as
+stem -> 2 reduction cells -> 3 x [N normal cells (+ reduction)] -> head.
+`nasnet` is the mobile size (4 @ 1056: N=4, 44 base filters, 224x224);
+`nasnetlarge` is 6 @ 4032 (N=6, 168 base filters, 331x331).
+
+TPU notes: separable convs run depthwise on the VPU
+(``feature_group_count``) and pointwise on the MXU like MobileNet; the
+many small branch ops make this the most fusion-stressing member of the
+zoo (same role DenseNet plays at CIFAR scale).  Aux head omitted (zoo
+convention here — benchmark loss never consumes it).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (op, hidden-state index) pairs, two per block, from the NASNet-A cells.
+# States list starts [current(0), previous(1)]; each block appends its sum.
+_NORMAL = [
+    ("sep5", 0), ("sep3", 1),
+    ("sep5", 1), ("sep3", 1),
+    ("avg", 0), ("id", 1),
+    ("avg", 1), ("avg", 1),
+    ("sep3", 0), ("id", 0),
+]
+_NORMAL_CONCAT = [1, 2, 3, 4, 5, 6]      # unused states (0 is consumed)
+
+_REDUCTION = [
+    ("sep5", 0), ("sep7", 1),
+    ("max", 0), ("sep7", 1),
+    ("avg", 0), ("sep5", 1),
+    ("id", 3), ("avg", 2),
+    ("sep3", 2), ("max", 0),
+]
+_REDUCTION_CONCAT = [3, 4, 5, 6]         # states 0..2 are consumed
+
+
+class SepConv(nn.Module):
+    """NASNet separable op: 2x (relu -> depthwise k×k -> 1x1 -> BN); the
+    stride lives on the first depthwise."""
+
+    filters: int
+    kernel: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for rep, stride in enumerate((self.stride, 1)):
+            c = x.shape[-1]
+            x = nn.relu(x)
+            x = nn.Conv(c, (self.kernel, self.kernel),
+                        strides=(stride, stride), feature_group_count=c,
+                        use_bias=False, padding="SAME", dtype=self.dtype,
+                        name=f"dw{rep}")(x)
+            x = nn.Conv(self.filters, (1, 1), use_bias=False,
+                        dtype=self.dtype, name=f"pw{rep}")(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9997,
+                             epsilon=1e-3, dtype=self.dtype,
+                             name=f"bn{rep}")(x)
+        return x
+
+
+class _CellCommon(nn.Module):
+    """Shared machinery: input adjustment + op dispatch + block loop."""
+
+    filters: int
+    spec: tuple
+    concat: tuple
+    reduction: bool = False
+    dtype: Any = jnp.float32
+
+    def _norm(self, name, train):
+        return nn.BatchNorm(use_running_average=not train,
+                            momentum=0.9997, epsilon=1e-3, dtype=self.dtype,
+                            name=name)
+
+    def _fit(self, x, name, train):
+        """relu -> 1x1 -> BN to `filters` channels."""
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name=f"{name}_1x1")(x)
+        return self._norm(f"{name}_bn", train)(x)
+
+    def _factorized_reduce(self, x, name, train):
+        """Halve spatial, land on `filters` channels, without aliasing: two
+        stride-2 paths offset by one pixel, concatenated."""
+        x = nn.relu(x)
+        p1 = nn.avg_pool(x, (1, 1), strides=(2, 2))
+        p1 = nn.Conv(self.filters // 2, (1, 1), use_bias=False,
+                     dtype=self.dtype, name=f"{name}_p1")(p1)
+        p2 = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))[:, 1:, 1:, :]
+        p2 = nn.avg_pool(p2, (1, 1), strides=(2, 2))
+        p2 = nn.Conv(self.filters - self.filters // 2, (1, 1),
+                     use_bias=False, dtype=self.dtype, name=f"{name}_p2")(p2)
+        return self._norm(f"{name}_bn", train)(
+            jnp.concatenate([p1, p2], axis=-1))
+
+    def _op(self, kind, x, stride, name, train):
+        if kind == "id":
+            return x
+        if kind in ("avg", "max"):
+            pool = nn.avg_pool if kind == "avg" else nn.max_pool
+            return pool(x, (3, 3), strides=(stride, stride), padding="SAME")
+        k = {"sep3": 3, "sep5": 5, "sep7": 7}[kind]
+        return SepConv(self.filters, k, stride, dtype=self.dtype,
+                       name=name)(x, train=train)
+
+    @nn.compact
+    def __call__(self, x, prev, train: bool = True):
+        if prev is None:
+            prev = x
+        if prev.shape[1] != x.shape[1]:
+            prev = self._factorized_reduce(prev, "adjust_prev", train)
+        elif prev.shape[-1] != self.filters:
+            prev = self._fit(prev, "adjust_prev", train)
+        cur = self._fit(x, "base", train)
+        states = [cur, prev]
+        for b in range(5):
+            (op_l, i_l), (op_r, i_r) = self.spec[2 * b], self.spec[2 * b + 1]
+            outs = []
+            for side, (op, i) in (("l", (op_l, i_l)), ("r", (op_r, i_r))):
+                stride = 2 if self.reduction and i < 2 else 1
+                outs.append(self._op(op, states[i], stride,
+                                     f"b{b}{side}_{op}", train))
+            states.append(outs[0] + outs[1])
+        return jnp.concatenate([states[i] for i in self.concat], axis=-1)
+
+
+def NormalCell(filters, dtype, name):
+    return _CellCommon(filters, tuple(_NORMAL), tuple(_NORMAL_CONCAT),
+                       reduction=False, dtype=dtype, name=name)
+
+
+def ReductionCell(filters, dtype, name):
+    return _CellCommon(filters, tuple(_REDUCTION), tuple(_REDUCTION_CONCAT),
+                       reduction=True, dtype=dtype, name=name)
+
+
+class NASNetA(nn.Module):
+    num_cells: int = 4                   # normal cells per stack
+    base_filters: int = 44
+    stem_filters: int = 32
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        f = self.base_filters
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.stem_filters, (3, 3), strides=(2, 2),
+                    use_bias=False, padding="VALID", dtype=self.dtype,
+                    name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9997,
+                         epsilon=1e-3, dtype=self.dtype, name="stem_bn")(x)
+        prev, cur = None, x
+        cur, prev = ReductionCell(f // 4, self.dtype, "stem_reduce0")(
+            cur, prev, train), cur
+        cur, prev = ReductionCell(f // 2, self.dtype, "stem_reduce1")(
+            cur, prev, train), cur
+        for stack in range(3):
+            filters = f * 2 ** stack
+            for i in range(self.num_cells):
+                cur, prev = NormalCell(
+                    filters, self.dtype, f"s{stack}_cell{i}")(
+                        cur, prev, train), cur
+            if stack < 2:
+                cur, prev = ReductionCell(
+                    filters * 2, self.dtype, f"reduce{stack}")(
+                        cur, prev, train), cur
+        x = nn.relu(cur)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def nasnet(num_classes=1000, dtype=jnp.float32):
+    """NASNet-A mobile, 4 @ 1056 (224x224)."""
+    return NASNetA(num_cells=4, base_filters=44, stem_filters=32,
+                   num_classes=num_classes, dtype=dtype)
+
+
+def nasnetlarge(num_classes=1000, dtype=jnp.float32):
+    """NASNet-A large, 6 @ 4032 (331x331)."""
+    return NASNetA(num_cells=6, base_filters=168, stem_filters=96,
+                   num_classes=num_classes, dtype=dtype)
