@@ -25,6 +25,8 @@ DEFAULT_MATRIX = [
     ("overfeat", 256),
     ("googlenet", 256),
     ("mobilenet", 256),
+    ("nasnet", 128),
+    ("nasnetlarge", 16),
     ("densenet40_k12", 512),
     ("densenet100_k12", 256),
     ("resnet18", 256),
@@ -44,6 +46,7 @@ DEFAULT_MATRIX = [
     ("inception3", 128),
     ("inception4", 64),
     ("bert_base", 128),
+    ("bert_large", 32),
 ]
 
 

@@ -107,8 +107,21 @@ class BertMLM(nn.Module):
             self.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
         )
         x = embed(token_ids)
+        # under sequence parallelism each shard holds s/n tokens; global
+        # position = shard offset + local offset
+        pos_ids = jnp.arange(s)
+        if self.seq_axis is not None:
+            import jax
+
+            global_s = s * jax.lax.axis_size(self.seq_axis)
+            if global_s > self.max_len:
+                raise ValueError(
+                    f"global sequence {global_s} exceeds max_len "
+                    f"{self.max_len} (nn.Embed would silently clamp)"
+                )
+            pos_ids = pos_ids + jax.lax.axis_index(self.seq_axis) * s
         pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
-                       name="pos_embed")(jnp.arange(s)[None, :])
+                       name="pos_embed")(pos_ids[None, :])
         x = nn.LayerNorm(dtype=self.dtype)(x + pos)
         x = nn.Dropout(0.1, deterministic=not train)(x)
         for i in range(self.num_layers):
