@@ -1,5 +1,6 @@
 """TFRecord codec + ImageNet pipeline tests (pure host-side, no TF)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -90,3 +91,26 @@ def test_eval_central_crop(tmp_path):
     images, labels = next(iter(ds))
     assert images.shape == (2, 24, 24, 3)
     assert np.isfinite(images).all()
+
+
+def test_uint8_wire_format_matches_float32(tmp_path):
+    """uint8 wire format + device-side normalize == float32 wire format."""
+    imagenet.make_synthetic_shards(
+        tmp_path, num_shards=1, examples_per_shard=6, image_size=32,
+        num_classes=7,
+    )
+    kw = dict(global_batch=4, image_size=16, train=True, seed=3)
+    f32_img, f32_lab = next(iter(
+        imagenet.ImageNetDataset(tmp_path, **kw)))
+    u8_img, u8_lab = next(iter(
+        imagenet.ImageNetDataset(tmp_path, wire_dtype="uint8", **kw)))
+    assert u8_img.dtype == np.uint8
+    np.testing.assert_array_equal(f32_lab, u8_lab)
+
+    from tpu_hc_bench.train.step import prep_inputs
+
+    np.testing.assert_allclose(np.asarray(prep_inputs(jnp.asarray(u8_img))),
+                               f32_img, rtol=1e-5, atol=1e-5)
+    # float32 batches pass through untouched
+    np.testing.assert_array_equal(
+        np.asarray(prep_inputs(jnp.asarray(f32_img))), f32_img)

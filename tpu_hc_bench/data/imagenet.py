@@ -53,7 +53,7 @@ def shards_for_worker(
 
 def _decode_and_crop(
     jpeg_bytes: bytes, image_size: int, rng: np.random.Generator,
-    train: bool,
+    train: bool, normalize: bool = True,
 ) -> np.ndarray:
     from PIL import Image
 
@@ -73,7 +73,7 @@ def _decode_and_crop(
                 img = img.crop((x0, y0, x0 + cw, y0 + ch))
                 break
         img = img.resize((image_size, image_size), Image.BILINEAR)
-        arr = np.asarray(img, np.float32)
+        arr = np.asarray(img)
         if rng.random() < 0.5:
             arr = arr[:, ::-1]
     else:
@@ -83,8 +83,10 @@ def _decode_and_crop(
         w2, h2 = img.size
         x0, y0 = (w2 - image_size) // 2, (h2 - image_size) // 2
         img = img.crop((x0, y0, x0 + image_size, y0 + image_size))
-        arr = np.asarray(img, np.float32)
-    return (arr - IMAGENET_MEAN) / IMAGENET_STD
+        arr = np.asarray(img)
+    if not normalize:          # uint8 wire format: normalize on device
+        return arr
+    return (arr.astype(np.float32) - IMAGENET_MEAN) / IMAGENET_STD
 
 
 class ImageNetDataset:
@@ -107,7 +109,10 @@ class ImageNetDataset:
         seed: int = 0,
         prefetch: int = 2,
         labels_zero_based: bool = False,
+        wire_dtype: str = "float32",
     ):
+        if wire_dtype not in ("float32", "uint8"):
+            raise ValueError(f"wire_dtype must be float32|uint8: {wire_dtype}")
         self.shards = shards_for_worker(
             find_shards(data_dir, split), worker, num_workers
         )
@@ -117,6 +122,9 @@ class ImageNetDataset:
         self.seed = seed
         self.prefetch = prefetch
         self.label_offset = 0 if labels_zero_based else 1  # ilsvrc is 1-based
+        # "uint8" ships raw crops (4x less host->device traffic; the MXU-
+        # feeding normalize runs on device — see driver.device_normalize)
+        self.wire_dtype = wire_dtype
 
     @staticmethod
     def _read_shard(path: str) -> Iterator[bytes]:
@@ -151,12 +159,15 @@ class ImageNetDataset:
         rng = np.random.default_rng(self.seed)
         stream = self._example_stream()
         s = self.image_size
+        normalize = self.wire_dtype == "float32"
+        dtype = np.float32 if normalize else np.uint8
         while True:
-            images = np.empty((self.global_batch, s, s, 3), np.float32)
+            images = np.empty((self.global_batch, s, s, 3), dtype)
             labels = np.empty((self.global_batch,), np.int32)
             for i in range(self.global_batch):
                 jpeg, label = next(stream)
-                images[i] = _decode_and_crop(jpeg, s, rng, self.train)
+                images[i] = _decode_and_crop(jpeg, s, rng, self.train,
+                                             normalize=normalize)
                 labels[i] = label
             yield images, labels
 

@@ -122,6 +122,9 @@ class BenchmarkConfig:
     seq_len: int | None = None                # text models: override the
                                               # registry sequence length
                                               # (long-context runs)
+    wire_dtype: str = "uint8"                 # real-data host->device wire
+                                              # format; uint8 = 4x less
+                                              # traffic, normalize on device
     attention_impl: str = "dense"             # dense|flash: transformer
                                               # attention kernel (flash =
                                               # Pallas blocked softmax)
@@ -227,6 +230,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_space_to_depth", type=_parse_bool,
                    default=d.use_space_to_depth)
     p.add_argument("--seq_len", type=int, default=d.seq_len)
+    p.add_argument("--wire_dtype", type=str, default=d.wire_dtype,
+                   choices=["float32", "uint8"])
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"])
     return p
