@@ -271,3 +271,34 @@ def test_bf16_compute_keeps_fp32_params_and_logits():
         assert leaf.dtype == jnp.float32
     out = model.apply(variables, x, train=False)
     assert out.dtype == jnp.float32
+
+
+def test_gpt2_params_and_causality():
+    from tpu_hc_bench.models import gpt
+
+    model = gpt.gpt2()
+    x = jnp.zeros((1, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    count = n_params(variables["params"])
+    # GPT-2 small, tied embeddings: 124.4M
+    assert abs(count - 124.4e6) / 124.4e6 < 0.01, count
+
+    # causality: perturbing token t must not change logits at positions < t
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 1, 1000)
+    base = model.apply(variables, toks, train=False)
+    toks2 = toks.at[0, 10].set(999)
+    pert = model.apply(variables, toks2, train=False)
+    np.testing.assert_allclose(base[0, :10], pert[0, :10],
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(base[0, 10:], pert[0, 10:])
+
+
+def test_gpt2_registry_and_synthetic_lm():
+    from tpu_hc_bench.data.synthetic import SyntheticTokens
+
+    spec = models.get_model_spec("gpt2")
+    assert spec.is_text and spec.causal_lm and spec.vocab_size == 50257
+    ds = SyntheticTokens(2, 8, vocab_size=100, causal_lm=True)
+    toks, targets, weights = ds.batch()
+    np.testing.assert_array_equal(targets[:, :-1], toks[:, 1:])
+    assert weights[:, -1].sum() == 0 and weights[:, :-1].all()

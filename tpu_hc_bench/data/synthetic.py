@@ -55,9 +55,21 @@ class SyntheticTokens:
     vocab_size: int = 30522
     mask_rate: float = 0.15
     seed: int = 0
+    causal_lm: bool = False            # next-token objective (GPT members)
+                                       # instead of masked-LM
 
     def batch(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         rng = np.random.default_rng(self.seed)
+        if self.causal_lm:
+            tokens = rng.integers(
+                1, self.vocab_size, size=(self.global_batch, self.seq_len),
+                dtype=np.int32,
+            )
+            # predict token t+1 at position t; final position has no target
+            targets = np.roll(tokens, -1, axis=1)
+            weights = np.ones_like(tokens, np.float32)
+            weights[:, -1] = 0.0
+            return tokens, targets, weights
         targets = rng.integers(
             1, self.vocab_size, size=(self.global_batch, self.seq_len),
             dtype=np.int32,

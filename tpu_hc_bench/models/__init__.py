@@ -43,11 +43,13 @@ class ModelSpec:
     is_text: bool = False
     default_image_size: int = 224
     supports_s2d: bool = False         # stem accepts space_to_depth=True
+    vocab_size: int = 30522            # text models: synthetic-data label space
+    causal_lm: bool = False            # text models: next-token objective
 
 
 def _registry() -> dict[str, ModelSpec]:
     from tpu_hc_bench.models import (
-        alexnet, bert, cifar_resnet, densenet, googlenet, inception,
+        alexnet, bert, cifar_resnet, densenet, googlenet, gpt, inception,
         mobilenet, nasnet, resnet, small_cnns, vgg,
     )
 
@@ -112,7 +114,12 @@ def _registry() -> dict[str, ModelSpec]:
                   is_text=True),
         # ~4.5M params, seq 64: CPU-smoke/test variant of the MLM path
         ModelSpec("bert_tiny", bert.bert_tiny_mlm, (64,), 2 * 4.5e6 * 64,
-                  is_text=True),
+                  is_text=True, vocab_size=1024),
+        # decoder family (causal LM; beyond-reference — see models/gpt.py)
+        ModelSpec("gpt2", gpt.gpt2, (1024,), 2 * 124e6 * 1024,
+                  is_text=True, vocab_size=gpt.GPT2_VOCAB, causal_lm=True),
+        ModelSpec("gpt2_medium", gpt.gpt2_medium, (1024,), 2 * 355e6 * 1024,
+                  is_text=True, vocab_size=gpt.GPT2_VOCAB, causal_lm=True),
     ]
     return {s.name: s for s in specs}
 

@@ -41,6 +41,7 @@ class MultiHeadAttention(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "dense"
     seq_axis: str | None = None
+    causal: bool = False               # decoder (GPT) members set this
 
     @nn.compact
     def __call__(self, x):
@@ -51,7 +52,7 @@ class MultiHeadAttention(nn.Module):
         from tpu_hc_bench.parallel.sequence import local_attention
 
         out = local_attention(q, k, v, impl=self.attention_impl,
-                              axis_name=self.seq_axis)
+                              axis_name=self.seq_axis, causal=self.causal)
         return nn.DenseGeneral(self.hidden, axis=(-2, -1), dtype=self.dtype,
                                name="out")(out)
 
@@ -89,6 +90,27 @@ class TransformerLayer(nn.Module):
         return nn.LayerNorm(dtype=self.dtype)(x + y)
 
 
+def global_position_ids(s: int, seq_axis: str | None, max_len: int):
+    """Position ids for a (possibly sequence-sharded) block of length s.
+
+    Under sequence parallelism each shard holds s/n tokens; global position
+    = shard offset + local offset.  Validates the global length against the
+    position table (nn.Embed silently clamps out-of-range indices).
+    """
+    pos_ids = jnp.arange(s)
+    if seq_axis is None:
+        return pos_ids
+    import jax
+
+    global_s = s * jax.lax.axis_size(seq_axis)
+    if global_s > max_len:
+        raise ValueError(
+            f"global sequence {global_s} exceeds max_len {max_len} "
+            f"(nn.Embed would silently clamp)"
+        )
+    return pos_ids + jax.lax.axis_index(seq_axis) * s
+
+
 class BertMLM(nn.Module):
     vocab_size: int = BERT_BASE_VOCAB
     hidden: int = BERT_BASE_HIDDEN
@@ -107,19 +129,7 @@ class BertMLM(nn.Module):
             self.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
         )
         x = embed(token_ids)
-        # under sequence parallelism each shard holds s/n tokens; global
-        # position = shard offset + local offset
-        pos_ids = jnp.arange(s)
-        if self.seq_axis is not None:
-            import jax
-
-            global_s = s * jax.lax.axis_size(self.seq_axis)
-            if global_s > self.max_len:
-                raise ValueError(
-                    f"global sequence {global_s} exceeds max_len "
-                    f"{self.max_len} (nn.Embed would silently clamp)"
-                )
-            pos_ids = pos_ids + jax.lax.axis_index(self.seq_axis) * s
+        pos_ids = global_position_ids(s, self.seq_axis, self.max_len)
         pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
                        name="pos_embed")(pos_ids[None, :])
         x = nn.LayerNorm(dtype=self.dtype)(x + pos)

@@ -1,0 +1,106 @@
+"""GPT-2-style causal LM in Flax — the decoder-side text family.
+
+Beyond-reference member (the reference's text config is BERT MLM only;
+BASELINE.json config 4): a pre-LN decoder with learned positions and tied
+output embedding, sized to GPT-2 small (12L/768H, vocab 50257, ctx 1024,
+~124M params) and medium (24L/1024H, ~355M).
+
+This is the workload that exercises the framework's *causal* long-context
+machinery end-to-end: ``--attention_impl=flash`` uses the Pallas kernel's
+causal dead-tile skip (tiles above the diagonal never touch the MXU), and
+under a seq mesh axis the ring/Ulysses paths apply their causal masking.
+Shares ``MultiHeadAttention`` with the BERT family — one attention
+dispatch serves both directions.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpu_hc_bench.models.bert import MultiHeadAttention, global_position_ids
+
+GPT2_VOCAB = 50257
+GPT2_CTX = 1024
+
+
+class DecoderLayer(nn.Module):
+    """Pre-LN (GPT-2): x + attn(LN(x)), then x + mlp(LN(x))."""
+
+    hidden: int
+    heads: int
+    ffn: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        h = MultiHeadAttention(
+            self.hidden, self.heads, dtype=self.dtype,
+            attention_impl=self.attention_impl, seq_axis=self.seq_axis,
+            causal=True,
+        )(h)
+        x = x + nn.Dropout(0.1, deterministic=not train)(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.ffn, dtype=self.dtype, name="fc")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.hidden, dtype=self.dtype, name="proj")(h)
+        return x + nn.Dropout(0.1, deterministic=not train)(h)
+
+
+class GPTLM(nn.Module):
+    vocab_size: int = GPT2_VOCAB
+    hidden: int = 768
+    num_layers: int = 12
+    heads: int = 12
+    ffn: int = 3072
+    max_len: int = GPT2_CTX
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = True):
+        b, s = token_ids.shape
+        embed = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype,
+                         name="wte")
+        pos_ids = global_position_ids(s, self.seq_axis, self.max_len)
+        x = embed(token_ids) + nn.Embed(
+            self.max_len, self.hidden, dtype=self.dtype, name="wpe"
+        )(pos_ids[None, :])
+        x = nn.Dropout(0.1, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            x = DecoderLayer(
+                self.hidden, self.heads, self.ffn, dtype=self.dtype,
+                attention_impl=self.attention_impl, seq_axis=self.seq_axis,
+                name=f"layer_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # tied output projection in explicit float32 (embed.attend would
+        # cast operands back to the Embed compute dtype, yielding bf16
+        # logits; the 50k-vocab cross-entropy wants f32)
+        return jnp.einsum(
+            "bsh,vh->bsv", x.astype(jnp.float32),
+            embed.embedding.astype(jnp.float32),
+        )
+
+
+def gpt2(num_classes: int = 0, dtype=jnp.float32,
+         attention_impl: str = "dense", max_len: int | None = None):
+    """GPT-2 small (124M); num_classes is ignored (vocab is the space)."""
+    del num_classes
+    return GPTLM(dtype=dtype, attention_impl=attention_impl,
+                 max_len=max(GPT2_CTX, max_len or 0))
+
+
+def gpt2_medium(num_classes: int = 0, dtype=jnp.float32,
+                attention_impl: str = "dense", max_len: int | None = None):
+    """GPT-2 medium (~355M: 24L/1024H/16 heads)."""
+    del num_classes
+    return GPTLM(hidden=1024, num_layers=24, heads=16, ffn=4096,
+                 dtype=dtype, attention_impl=attention_impl,
+                 max_len=max(GPT2_CTX, max_len or 0))

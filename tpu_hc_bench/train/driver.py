@@ -352,7 +352,9 @@ def run_benchmark(
             yield from _prefetch(raw())
     elif spec.is_text:
         seq_len = spec.input_shape[0]
-        ds = SyntheticTokens(global_batch, seq_len, seed=cfg.seed)
+        ds = SyntheticTokens(global_batch, seq_len, seed=cfg.seed,
+                             vocab_size=spec.vocab_size,
+                             causal_lm=spec.causal_lm)
         batch = ds.batch()
 
         def batches():
