@@ -125,6 +125,13 @@ class BenchmarkConfig:
     wire_dtype: str = "uint8"                 # real-data host->device wire
                                               # format; uint8 = 4x less
                                               # traffic, normalize on device
+    model_parallel: int = 1                   # tensor-parallel degree over
+                                              # the mesh "model" axis
+                                              # (Megatron-style GSPMD
+                                              # shardings; transformers)
+    virtual_devices: int | None = None        # debug: provision N virtual
+                                              # CPU devices (multi-chip
+                                              # paths without hardware)
     attention_impl: str = "dense"             # dense|flash: transformer
                                               # attention kernel (flash =
                                               # Pallas blocked softmax)
@@ -166,6 +173,13 @@ class BenchmarkConfig:
             t["thread_tuning"] = (
                 "num_intra/inter_threads,kmp_* parsed but no-op on TPU"
             )
+        if self.model_parallel > 1 and self.variable_update != "replicated":
+            t["variable_update"] = (
+                f"{self.variable_update}->replicated (model_parallel="
+                f"{self.model_parallel} runs on the GSPMD arm; the explicit "
+                f"fused-psum path and fusion_threshold do not apply)"
+            )
+            self.variable_update = "replicated"
         self.translations = t
         return self
 
@@ -179,7 +193,9 @@ class BenchmarkConfig:
             f"data={'synthetic' if self.data_dir is None else self.data_dir} "
             f"({self.data_name}, {self.data_format})",
             f"variable_update={self.variable_update} "
-            f"fusion_threshold={self.fusion_threshold_bytes}B",
+            f"fusion_threshold={self.fusion_threshold_bytes}B"
+            + (f" model_parallel={self.model_parallel}"
+               if self.model_parallel > 1 else ""),
         ]
         for k, v in self.translations.items():
             lines.append(f"translated: {k}: {v}")
@@ -232,6 +248,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq_len", type=int, default=d.seq_len)
     p.add_argument("--wire_dtype", type=str, default=d.wire_dtype,
                    choices=["float32", "uint8"])
+    p.add_argument("--model_parallel", type=int, default=d.model_parallel)
+    p.add_argument("--virtual_devices", type=int, default=d.virtual_devices)
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"])
     return p

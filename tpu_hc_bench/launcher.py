@@ -52,6 +52,13 @@ def main(argv: list[str] | None = None) -> int:
         num_hosts, workers_per_host, fabric_name = None, 0, "ici"
     cfg = flags.parse_flags(rest)
 
+    if cfg.virtual_devices:
+        # must land before the first backend query (discover_layout);
+        # this jaxlib ignores --xla_force_host_platform_device_count
+        import jax
+
+        jax.config.update("jax_num_cpu_devices", cfg.virtual_devices)
+
     if num_hosts is not None and num_hosts > 1:
         distributed.initialize()
 

@@ -292,8 +292,21 @@ def run_benchmark(
 
     fab = fabric_mod.resolve_fabric(fabric_name)
     layout = layout or discover_layout()
-    mesh = build_mesh(layout)
-    global_batch = layout.global_batch(cfg.batch_size)
+    mp = max(1, cfg.model_parallel)
+    if layout.total_workers % mp:
+        raise ValueError(
+            f"--model_parallel={mp} does not divide "
+            f"{layout.total_workers} workers"
+        )
+    if mp > 1 and fab is fabric_mod.Fabric.HOST:
+        raise ValueError(
+            "--model_parallel requires a device fabric (ici/dcn): the host "
+            "path's shard_map would silently re-replicate the TP shards"
+        )
+    mesh = build_mesh(layout, model_parallel=mp)
+    # with TP, the data-parallel degree (and so the global batch at fixed
+    # per-worker batch) shrinks by the TP degree
+    global_batch = layout.global_batch(cfg.batch_size) // mp
 
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
@@ -375,9 +388,14 @@ def run_benchmark(
 
     # --- state + step ---
     state = step_mod.make_train_state(model, cfg, batch)
-    state = step_mod.replicate_state(state, mesh)
+    if mp > 1:
+        state = step_mod.shard_state_tp(state, mesh)
+    else:
+        state = step_mod.replicate_state(state, mesh)
     batch_iter = batches()
     if cfg.eval:
+        if mp > 1:
+            raise ValueError("--eval with --model_parallel is not supported")
         return _run_eval(
             cfg, spec, layout, mesh, state, batch_iter, global_batch,
             fab, print_fn,

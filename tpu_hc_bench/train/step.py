@@ -163,6 +163,10 @@ def build_train_step(
 
     if fab is fabric_mod.Fabric.HOST:
         return _build_host_step(mesh, cfg, is_text)
+    if getattr(cfg, "model_parallel", 1) > 1:
+        # TP runs on the GSPMD arm: params enter committed with
+        # tp_param_spec shardings and jit follows them
+        return _build_gspmd_step(mesh, cfg, is_text, follow_inputs=True)
     if cfg.variable_update == "replicated":
         return _build_gspmd_step(mesh, cfg, is_text)
 
@@ -226,7 +230,8 @@ def build_train_step(
     return step
 
 
-def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
+def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
+                      follow_inputs: bool = False):
     """``--variable_update=replicated``: the pure-GSPMD arm.
 
     No shard_map, no explicit collectives: the step is written over the
@@ -266,6 +271,10 @@ def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
         )
         return new_state, {"loss": loss}
 
+    if follow_inputs:
+        # TP: inputs arrive committed (shard_state_tp / shard_batch); jit
+        # follows those shardings and GSPMD inserts the TP collectives
+        return jax.jit(step_fn, donate_argnums=(0,))
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P(DATA_AXIS))
     return jax.jit(
@@ -370,6 +379,86 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
         check_vma=False,
     )
     return jax.jit(shard_fn)
+
+
+def tp_param_spec(path: str, ndim: int) -> P:
+    """Megatron-style tensor-parallel PartitionSpec for a transformer param.
+
+    Column-parallel QKV/FFN-in (shard the output features over the model
+    axis), row-parallel out-proj/FFN-down (shard the input features) — the
+    classic layout where each block needs exactly one all-reduce per
+    direction, which GSPMD inserts automatically.  Non-transformer params
+    (and everything unmatched) replicate, so the rules are safe to apply to
+    any model in the zoo.
+
+    Matches both naming schemes: BERT's anonymous FFN denses
+    (``Dense_0``/``Dense_1``) and GPT's ``fc``/``proj``.
+    """
+    from tpu_hc_bench.topology import MODEL_AXIS as M
+
+    rules = [
+        ("qkv/kernel", P(None, None, M, None)),    # [C, 3, heads, d]
+        ("qkv/bias", P(None, M, None)),            # [3, heads, d]
+        ("out/kernel", P(M, None, None)),          # [heads, d, C]
+        ("Dense_0/kernel", P(None, M)),            # FFN in  [C, ffn]
+        ("Dense_0/bias", P(M)),
+        ("Dense_1/kernel", P(M, None)),            # FFN out [ffn, C]
+        ("fc/kernel", P(None, M)),
+        ("fc/bias", P(M)),
+        ("proj/kernel", P(M, None)),
+    ]
+    for suffix, spec in rules:
+        if path.endswith(suffix) and len(spec) == ndim:
+            return spec
+    return P()
+
+
+def _param_specs(params) -> dict:
+    """Pytree of PartitionSpecs matching ``params`` via tp_param_spec."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, v: tp_param_spec(
+            "/".join(getattr(k, "key", str(k)) for k in path), v.ndim
+        ),
+        params,
+    )
+
+
+def shard_state_tp(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place the state with tensor-parallel param shardings.
+
+    Params (and the optimizer state, which mirrors the param tree — e.g.
+    the momentum trace) are sharded per ``tp_param_spec``; everything else
+    replicates.  The jitted GSPMD step then *follows* these committed
+    shardings, so the same ``_build_gspmd_step`` serves DP and DP x TP.
+    """
+    specs = _param_specs(state.params)
+
+    def put(spec_tree, tree):
+        return jax.tree.map(
+            lambda spec, x: jax.device_put(x, NamedSharding(mesh, spec)),
+            spec_tree, tree,
+        )
+
+    params = put(specs, state.params)
+    # optimizer state: shard any subtree whose structure mirrors params
+    # (momentum/adam moments), replicate the rest (counts, empty states)
+    def put_opt(node):
+        if jax.tree.structure(node) == jax.tree.structure(state.params):
+            return put(specs, node)
+        return jax.device_put(node, NamedSharding(mesh, P()))
+
+    opt_state = jax.tree.map(
+        put_opt, state.opt_state,
+        is_leaf=lambda n: jax.tree.structure(n)
+        == jax.tree.structure(state.params),
+    )
+    rest = NamedSharding(mesh, P())
+    return state.replace(
+        step=jax.device_put(state.step, rest),
+        params=params,
+        batch_stats=jax.device_put(state.batch_stats, rest),
+        opt_state=opt_state,
+    )
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
