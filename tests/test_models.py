@@ -302,3 +302,34 @@ def test_gpt2_registry_and_synthetic_lm():
     toks, targets, weights = ds.batch()
     np.testing.assert_array_equal(targets[:, :-1], toks[:, 1:])
     assert weights[:, -1].sum() == 0 and weights[:, :-1].all()
+
+
+def test_gradient_checkpointing_matches():
+    """Remat changes memory, not math: same loss and grads — including in
+    train mode, where the recomputed dropout masks must reuse the forward
+    pass's RNG."""
+    import optax
+
+    x = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 1, 1000)
+    y = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 1, 1000)
+
+    def loss_for(remat):
+        model, _ = models.create_model("bert_tiny",
+                                       gradient_checkpointing=remat)
+        variables = model.init(jax.random.PRNGKey(2), x, train=False)
+
+        def loss_fn(p):
+            logits = model.apply(
+                {"params": p}, x, train=True,
+                rngs={"dropout": jax.random.PRNGKey(3)})
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        return jax.value_and_grad(loss_fn)(variables["params"])
+
+    (l0, g0), (l1, g1) = loss_for(False), loss_for(True)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    assert (jax.tree_util.tree_structure(g0)
+            == jax.tree_util.tree_structure(g1))
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)

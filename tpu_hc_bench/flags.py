@@ -132,6 +132,9 @@ class BenchmarkConfig:
     virtual_devices: int | None = None        # debug: provision N virtual
                                               # CPU devices (multi-chip
                                               # paths without hardware)
+    gradient_checkpointing: bool = False      # remat transformer layers:
+                                              # trade FLOPs for activation
+                                              # HBM (long-context headroom)
     attention_impl: str = "dense"             # dense|flash: transformer
                                               # attention kernel (flash =
                                               # Pallas blocked softmax)
@@ -250,6 +253,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "uint8"])
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
     p.add_argument("--virtual_devices", type=int, default=d.virtual_devices)
+    p.add_argument("--gradient_checkpointing", type=_parse_bool,
+                   default=d.gradient_checkpointing)
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"])
     return p

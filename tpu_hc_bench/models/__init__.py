@@ -156,11 +156,13 @@ def list_models() -> list[str]:
 
 def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                  attention_impl: str = "dense", space_to_depth: bool = False,
-                 seq_len: int | None = None):
+                 seq_len: int | None = None,
+                 gradient_checkpointing: bool = False):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
     if spec.is_text:   # attention kernel choice only exists for transformers
         kwargs["attention_impl"] = attention_impl
+        kwargs["remat"] = gradient_checkpointing
         if seq_len is not None:
             # long-context override: rescale the linear-in-seq FLOP figure
             # (conservative — ignores the quadratic attention term); the
@@ -171,8 +173,14 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                 flops_per_example=spec.flops_per_example
                 * seq_len / spec.input_shape[0],
             )
-    elif seq_len is not None:
-        raise ValueError(f"--seq_len only applies to text models, not {name}")
+    else:
+        if gradient_checkpointing:
+            raise ValueError(
+                "--gradient_checkpointing currently applies to transformer "
+                f"members only, not {name}")
+        if seq_len is not None:
+            raise ValueError(
+                f"--seq_len only applies to text models, not {name}")
     if spec.supports_s2d:
         kwargs["space_to_depth"] = space_to_depth
     elif space_to_depth:

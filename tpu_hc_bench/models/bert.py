@@ -121,6 +121,11 @@ class BertMLM(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "dense"
     seq_axis: str | None = None
+    remat: bool = False                # recompute each layer in backward:
+                                       # store only per-layer boundaries
+                                       # (O(L) boundaries, no layer
+                                       # interiors) -> long-context HBM
+                                       # headroom at ~1/3 extra FLOPs
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True):
@@ -134,12 +139,16 @@ class BertMLM(nn.Module):
                        name="pos_embed")(pos_ids[None, :])
         x = nn.LayerNorm(dtype=self.dtype)(x + pos)
         x = nn.Dropout(0.1, deterministic=not train)(x)
+        # static_argnums counts bound-method args with self=0:
+        # (self, x, mask, train) -> mask and train are static
+        layer_cls = (nn.remat(TransformerLayer, static_argnums=(2, 3))
+                     if self.remat else TransformerLayer)
         for i in range(self.num_layers):
-            x = TransformerLayer(
+            x = layer_cls(
                 self.hidden, self.heads, self.ffn, dtype=self.dtype,
                 attention_impl=self.attention_impl, seq_axis=self.seq_axis,
                 name=f"layer_{i}",
-            )(x, train=train)
+            )(x, None, train)
         # MLM head: dense+gelu+LN, then tied-embedding projection
         x = nn.Dense(self.hidden, dtype=self.dtype, name="mlm_dense")(x)
         x = nn.gelu(x)
@@ -150,33 +159,36 @@ class BertMLM(nn.Module):
 
 
 def bert_base_mlm(num_classes: int = 0, dtype=jnp.float32,
-                  attention_impl: str = "dense", max_len: int | None = None):
+                  attention_impl: str = "dense", max_len: int | None = None,
+                  remat: bool = False):
     """Registry adapter; num_classes is ignored (vocab is the label space).
 
     ``max_len`` only ever *grows* the position table past the canonical 512
     (long-context runs); shorter sequences keep the published shape."""
     del num_classes
     return BertMLM(dtype=dtype, attention_impl=attention_impl,
-                   max_len=max(BERT_MAX_LEN, max_len or 0))
+                   max_len=max(BERT_MAX_LEN, max_len or 0), remat=remat)
 
 
 def bert_large_mlm(num_classes: int = 0, dtype=jnp.float32,
-                   attention_impl: str = "dense", max_len: int | None = None):
+                   attention_impl: str = "dense", max_len: int | None = None,
+                   remat: bool = False):
     """BERT-large (24L/1024H/16 heads/4096 FFN, ~335M params)."""
     del num_classes
     return BertMLM(
         hidden=1024, num_layers=24, heads=16, ffn=4096,
         max_len=max(BERT_MAX_LEN, max_len or 0),
-        dtype=dtype, attention_impl=attention_impl,
+        dtype=dtype, attention_impl=attention_impl, remat=remat,
     )
 
 
 def bert_tiny_mlm(num_classes: int = 0, dtype=jnp.float32,
-                  attention_impl: str = "dense", max_len: int | None = None):
+                  attention_impl: str = "dense", max_len: int | None = None,
+                  remat: bool = False):
     """4-layer/128-hidden variant for tests and CPU smoke runs."""
     del num_classes
     return BertMLM(
         vocab_size=1024, hidden=128, num_layers=4, heads=4, ffn=512,
         max_len=max(128, max_len or 0), dtype=dtype,
-        attention_impl=attention_impl,
+        attention_impl=attention_impl, remat=remat,
     )

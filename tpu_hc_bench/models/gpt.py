@@ -62,6 +62,7 @@ class GPTLM(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "dense"
     seq_axis: str | None = None
+    remat: bool = False                # recompute layers in backward
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True):
@@ -73,12 +74,16 @@ class GPTLM(nn.Module):
             self.max_len, self.hidden, dtype=self.dtype, name="wpe"
         )(pos_ids[None, :])
         x = nn.Dropout(0.1, deterministic=not train)(x)
+        # static_argnums counts bound-method args with self=0:
+        # (self, x, train) -> train is static
+        layer_cls = (nn.remat(DecoderLayer, static_argnums=(2,))
+                     if self.remat else DecoderLayer)
         for i in range(self.num_layers):
-            x = DecoderLayer(
+            x = layer_cls(
                 self.hidden, self.heads, self.ffn, dtype=self.dtype,
                 attention_impl=self.attention_impl, seq_axis=self.seq_axis,
                 name=f"layer_{i}",
-            )(x, train=train)
+            )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         # tied output projection in explicit float32 (embed.attend would
         # cast operands back to the Embed compute dtype, yielding bf16
@@ -90,17 +95,19 @@ class GPTLM(nn.Module):
 
 
 def gpt2(num_classes: int = 0, dtype=jnp.float32,
-         attention_impl: str = "dense", max_len: int | None = None):
+         attention_impl: str = "dense", max_len: int | None = None,
+         remat: bool = False):
     """GPT-2 small (124M); num_classes is ignored (vocab is the space)."""
     del num_classes
     return GPTLM(dtype=dtype, attention_impl=attention_impl,
-                 max_len=max(GPT2_CTX, max_len or 0))
+                 max_len=max(GPT2_CTX, max_len or 0), remat=remat)
 
 
 def gpt2_medium(num_classes: int = 0, dtype=jnp.float32,
-                attention_impl: str = "dense", max_len: int | None = None):
+                attention_impl: str = "dense", max_len: int | None = None,
+                remat: bool = False):
     """GPT-2 medium (~355M: 24L/1024H/16 heads)."""
     del num_classes
     return GPTLM(hidden=1024, num_layers=24, heads=16, ffn=4096,
                  dtype=dtype, attention_impl=attention_impl,
-                 max_len=max(GPT2_CTX, max_len or 0))
+                 max_len=max(GPT2_CTX, max_len or 0), remat=remat)

@@ -312,7 +312,8 @@ def run_benchmark(
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, attention_impl=cfg.attention_impl,
                                space_to_depth=cfg.use_space_to_depth,
-                               seq_len=cfg.seq_len)
+                               seq_len=cfg.seq_len,
+                               gradient_checkpointing=cfg.gradient_checkpointing)
 
     # --- banner (reference :52-58 config echo) ---
     for line in layout.summary_lines(fabric=fab.value):
