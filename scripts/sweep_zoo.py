@@ -47,6 +47,8 @@ DEFAULT_MATRIX = [
     ("inception4", 64),
     ("bert_base", 128),
     ("bert_large", 32),
+    ("gpt2", 16),
+    ("gpt2_medium", 4),
 ]
 
 

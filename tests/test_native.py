@@ -56,3 +56,41 @@ def test_large_file_throughput(tmp_path):
     native_recs = native.read_records_native(path)
     py_recs = list(tfrecord.read_records(path, verify_crc=True))
     assert native_recs == py_recs
+
+
+def test_native_jpeg_matches_pil_pipeline():
+    """Native decode+crop+resize draws the same augmentation stream and
+    lands within JPEG/bilinear tolerance of the PIL fallback."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from tpu_hc_bench import native
+    from tpu_hc_bench.data import imagenet
+
+    if not native.jpeg_available():
+        import pytest
+
+        pytest.skip("native jpeg decoder unavailable")
+
+    # smooth gradient: resampling-path differences (DCT-scaled decode vs
+    # full-res PIL) stay small on natural-image-like content; random noise
+    # would amplify them
+    yy, xx = np.mgrid[0:280, 0:350]
+    img = np.stack([
+        (xx * 255 / 350), (yy * 255 / 280), ((xx + yy) * 255 / 630)
+    ], axis=-1).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "JPEG", quality=95)
+    data = buf.getvalue()
+
+    for train in (True, False):
+        a = imagenet._decode_and_crop(
+            data, 64, np.random.default_rng(7), train, normalize=False)
+        b = imagenet._decode_and_crop_pil(
+            data, 64, np.random.default_rng(7), train, normalize=False)
+        assert a.shape == b.shape == (64, 64, 3)
+        assert a.dtype == b.dtype == np.uint8
+        diff = np.abs(a.astype(int) - b.astype(int))
+        assert diff.mean() < 3.0, diff.mean()

@@ -55,26 +55,73 @@ def _decode_and_crop(
     jpeg_bytes: bytes, image_size: int, rng: np.random.Generator,
     train: bool, normalize: bool = True,
 ) -> np.ndarray:
+    """Decode -> (random-resized | central) crop -> [size, size, 3].
+
+    Fast path: the native libjpeg decoder (`native/jpeg_decoder.cpp`) does
+    decode+crop+resize in one C call with DCT scaling; the crop box and
+    flip are drawn HERE so the augmentation stream is identical to the PIL
+    fallback (same rng draws in the same order).
+    """
+    from tpu_hc_bench import native
+
+    try:
+        dims = native.jpeg_dims(jpeg_bytes)
+        if dims is None:                     # native lib unavailable
+            raise ValueError
+        w, h = dims
+        if train:
+            crop, flip = _sample_train_crop(w, h, rng)
+        else:
+            # central 87.5% square crop (the eval standard), resized
+            cs = int(round(0.875 * min(w, h)))
+            crop = ((w - cs) // 2, (h - cs) // 2, cs, cs)
+            flip = False
+        arr = native.jpeg_decode_crop_resize(
+            jpeg_bytes, crop, image_size, flip)
+    except ValueError:
+        # not a baseline RGB JPEG (ImageNet has a few CMYK files and one
+        # mislabeled PNG) — PIL handles those
+        return _decode_and_crop_pil(jpeg_bytes, image_size, rng, train,
+                                    normalize)
+    if not normalize:
+        return arr
+    return (arr.astype(np.float32) - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def _sample_train_crop(w, h, rng):
+    """Random-resized-crop box + flip (benchmark-standard: area 8%-100%,
+    aspect 3/4..4/3, 5 attempts, fall back to the full image).  The ONLY
+    sampler for both decode paths, so their augmentation RNG streams are
+    identical by construction."""
+    crop = (0, 0, w, h)
+    area = w * h
+    for _ in range(5):
+        target_area = area * rng.uniform(0.08, 1.0)
+        aspect = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if cw <= w and ch <= h:
+            x0 = int(rng.integers(0, w - cw + 1))
+            y0 = int(rng.integers(0, h - ch + 1))
+            crop = (x0, y0, cw, ch)
+            break
+    return crop, bool(rng.random() < 0.5)
+
+
+def _decode_and_crop_pil(
+    jpeg_bytes: bytes, image_size: int, rng: np.random.Generator,
+    train: bool, normalize: bool = True,
+) -> np.ndarray:
     from PIL import Image
 
     img = Image.open(io.BytesIO(jpeg_bytes)).convert("RGB")
     w, h = img.size
     if train:
-        # random resized crop: area 8%-100%, aspect 3/4..4/3 (benchmark std)
-        area = w * h
-        for _ in range(5):
-            target_area = area * rng.uniform(0.08, 1.0)
-            aspect = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
-            cw = int(round(np.sqrt(target_area * aspect)))
-            ch = int(round(np.sqrt(target_area / aspect)))
-            if cw <= w and ch <= h:
-                x0 = rng.integers(0, w - cw + 1)
-                y0 = rng.integers(0, h - ch + 1)
-                img = img.crop((x0, y0, x0 + cw, y0 + ch))
-                break
+        (x0, y0, cw, ch), flip = _sample_train_crop(w, h, rng)
+        img = img.crop((x0, y0, x0 + cw, y0 + ch))
         img = img.resize((image_size, image_size), Image.BILINEAR)
         arr = np.asarray(img)
-        if rng.random() < 0.5:
+        if flip:
             arr = arr[:, ::-1]
     else:
         # central crop at 87.5% then resize (eval standard)

@@ -19,24 +19,31 @@ _lib = None
 _build_failed = False
 
 
+def _build_and_load(lib_path: Path) -> ctypes.CDLL | None:
+    """make the specific target (so one library failing to build — e.g.
+    missing libjpeg headers — never disables the others), then dlopen."""
+    if not lib_path.exists():
+        try:
+            subprocess.run(
+                ["make", "-s", "-C", str(_DIR), lib_path.name],
+                check=True, capture_output=True, timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        return ctypes.CDLL(str(lib_path))
+    except OSError:
+        return None
+
+
 def _load() -> ctypes.CDLL | None:
     global _lib, _build_failed
     if _lib is not None:
         return _lib
     if _build_failed:
         return None
-    if not _LIB_PATH.exists():
-        try:
-            subprocess.run(
-                ["make", "-s", "-C", str(_DIR)],
-                check=True, capture_output=True, timeout=120,
-            )
-        except (OSError, subprocess.SubprocessError):
-            _build_failed = True
-            return None
-    try:
-        lib = ctypes.CDLL(str(_LIB_PATH))
-    except OSError:
+    lib = _build_and_load(_LIB_PATH)
+    if lib is None:
         _build_failed = True
         return None
     lib.thb_crc32c.restype = ctypes.c_uint32
@@ -107,3 +114,69 @@ def read_records_native(path: str | Path, verify: bool = True):
     return [
         data[int(o) : int(o) + int(l)] for o, l in zip(offsets, lengths)
     ]
+
+
+# --- native JPEG decode (jpeg_decoder.cpp; system libjpeg) ----------------
+
+_JPEG_PATH = _DIR / "libthb_jpeg.so"
+_jpeg_lib = None
+_jpeg_failed = False
+
+
+def _load_jpeg() -> ctypes.CDLL | None:
+    global _jpeg_lib, _jpeg_failed
+    if _jpeg_lib is not None:
+        return _jpeg_lib
+    if _jpeg_failed:
+        return None
+    lib = _build_and_load(_JPEG_PATH)
+    if lib is None:
+        _jpeg_failed = True
+        return None
+    lib.thb_jpeg_dims.restype = ctypes.c_int
+    lib.thb_jpeg_dims.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.thb_decode_crop_resize.restype = ctypes.c_int
+    lib.thb_decode_crop_resize.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+    ]
+    _jpeg_lib = lib
+    return lib
+
+
+def jpeg_available() -> bool:
+    return _load_jpeg() is not None
+
+
+def jpeg_dims(data: bytes) -> tuple[int, int] | None:
+    """(width, height) without decoding, or None if native unavailable."""
+    lib = _load_jpeg()
+    if lib is None:
+        return None
+    w, h = ctypes.c_int(), ctypes.c_int()
+    if lib.thb_jpeg_dims(data, len(data), ctypes.byref(w), ctypes.byref(h)):
+        raise ValueError("thb_jpeg_dims: not a decodable JPEG")
+    return w.value, h.value
+
+
+def jpeg_decode_crop_resize(
+    data: bytes, crop: tuple[int, int, int, int], out_size: int,
+    flip: bool = False,
+) -> np.ndarray | None:
+    """Decode + crop (x, y, w, h) + bilinear resize to [out_size]^2 uint8
+    RGB; None if native unavailable.  Raises ValueError on bad input."""
+    lib = _load_jpeg()
+    if lib is None:
+        return None
+    out = np.empty((out_size, out_size, 3), np.uint8)
+    rc = lib.thb_decode_crop_resize(
+        data, len(data), crop[0], crop[1], crop[2], crop[3],
+        out_size, 1 if flip else 0, out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc:
+        raise ValueError(f"thb_decode_crop_resize failed with code {rc}")
+    return out
