@@ -129,6 +129,11 @@ class BenchmarkConfig:
                                               # the mesh "model" axis
                                               # (Megatron-style GSPMD
                                               # shardings; transformers)
+    expert_parallel: int = 1                  # expert-parallel degree: MoE
+                                              # expert dim sharded over the
+                                              # mesh "model" axis (GSPMD
+                                              # all-to-all dispatch);
+                                              # exclusive with model_parallel
     virtual_devices: int | None = None        # debug: provision N virtual
                                               # CPU devices (multi-chip
                                               # paths without hardware)
@@ -176,11 +181,19 @@ class BenchmarkConfig:
             t["thread_tuning"] = (
                 "num_intra/inter_threads,kmp_* parsed but no-op on TPU"
             )
-        if self.model_parallel > 1 and self.variable_update != "replicated":
+        if self.model_parallel > 1 and self.expert_parallel > 1:
+            raise ValueError(
+                "--model_parallel and --expert_parallel are exclusive: both "
+                "shard over the mesh 'model' axis"
+            )
+        sharded = max(self.model_parallel, self.expert_parallel)
+        if sharded > 1 and self.variable_update != "replicated":
+            which = ("model_parallel" if self.model_parallel > 1
+                     else "expert_parallel")
             t["variable_update"] = (
-                f"{self.variable_update}->replicated (model_parallel="
-                f"{self.model_parallel} runs on the GSPMD arm; the explicit "
-                f"fused-psum path and fusion_threshold do not apply)"
+                f"{self.variable_update}->replicated ({which}={sharded} "
+                f"runs on the GSPMD arm; the explicit fused-psum path and "
+                f"fusion_threshold do not apply)"
             )
             self.variable_update = "replicated"
         self.translations = t
@@ -198,7 +211,9 @@ class BenchmarkConfig:
             f"variable_update={self.variable_update} "
             f"fusion_threshold={self.fusion_threshold_bytes}B"
             + (f" model_parallel={self.model_parallel}"
-               if self.model_parallel > 1 else ""),
+               if self.model_parallel > 1 else "")
+            + (f" expert_parallel={self.expert_parallel}"
+               if self.expert_parallel > 1 else ""),
         ]
         for k, v in self.translations.items():
             lines.append(f"translated: {k}: {v}")
@@ -252,6 +267,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--wire_dtype", type=str, default=d.wire_dtype,
                    choices=["float32", "uint8"])
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
+    p.add_argument("--expert_parallel", type=int, default=d.expert_parallel)
     p.add_argument("--virtual_devices", type=int, default=d.virtual_devices)
     p.add_argument("--gradient_checkpointing", type=_parse_bool,
                    default=d.gradient_checkpointing)

@@ -120,6 +120,12 @@ def _registry() -> dict[str, ModelSpec]:
                   is_text=True, vocab_size=gpt.GPT2_VOCAB, causal_lm=True),
         ModelSpec("gpt2_medium", gpt.gpt2_medium, (1024,), 2 * 355e6 * 1024,
                   is_text=True, vocab_size=gpt.GPT2_VOCAB, causal_lm=True),
+        # sparse MoE decoder: FLOPs figure counts *active* params per token
+        # (top-2 of 8 experts ~= 2x FFN of the dense 124M trunk)
+        ModelSpec("gpt2_moe", gpt.gpt2_moe, (1024,), 2 * 180e6 * 1024,
+                  is_text=True, vocab_size=gpt.GPT2_VOCAB, causal_lm=True),
+        ModelSpec("moe_tiny", gpt.moe_tiny, (64,), 2 * 3e6 * 64,
+                  is_text=True, vocab_size=1024, causal_lm=True),
     ]
     return {s.name: s for s in specs}
 

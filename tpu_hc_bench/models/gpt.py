@@ -27,7 +27,12 @@ GPT2_CTX = 1024
 
 
 class DecoderLayer(nn.Module):
-    """Pre-LN (GPT-2): x + attn(LN(x)), then x + mlp(LN(x))."""
+    """Pre-LN (GPT-2): x + attn(LN(x)), then x + mlp(LN(x)).
+
+    ``num_experts > 0`` swaps the dense MLP for a sparse MoE FFN
+    (``models.moe.MoEFFN``, Mixtral-style decoder) — the expert-parallel
+    workload.
+    """
 
     hidden: int
     heads: int
@@ -35,6 +40,8 @@ class DecoderLayer(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "dense"
     seq_axis: str | None = None
+    num_experts: int = 0
+    top_k: int = 2
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -46,9 +53,15 @@ class DecoderLayer(nn.Module):
         )(h)
         x = x + nn.Dropout(0.1, deterministic=not train)(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        h = nn.Dense(self.ffn, dtype=self.dtype, name="fc")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(self.hidden, dtype=self.dtype, name="proj")(h)
+        if self.num_experts:
+            from tpu_hc_bench.models.moe import MoEFFN
+
+            h = MoEFFN(self.hidden, self.ffn, self.num_experts,
+                       top_k=self.top_k, dtype=self.dtype, name="moe")(h)
+        else:
+            h = nn.Dense(self.ffn, dtype=self.dtype, name="fc")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.hidden, dtype=self.dtype, name="proj")(h)
         return x + nn.Dropout(0.1, deterministic=not train)(h)
 
 
@@ -63,6 +76,8 @@ class GPTLM(nn.Module):
     attention_impl: str = "dense"
     seq_axis: str | None = None
     remat: bool = False                # recompute layers in backward
+    num_experts: int = 0               # >0: MoE FFNs (models/moe.py)
+    top_k: int = 2
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True):
@@ -82,6 +97,7 @@ class GPTLM(nn.Module):
             x = layer_cls(
                 self.hidden, self.heads, self.ffn, dtype=self.dtype,
                 attention_impl=self.attention_impl, seq_axis=self.seq_axis,
+                num_experts=self.num_experts, top_k=self.top_k,
                 name=f"layer_{i}",
             )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -111,3 +127,25 @@ def gpt2_medium(num_classes: int = 0, dtype=jnp.float32,
     return GPTLM(hidden=1024, num_layers=24, heads=16, ffn=4096,
                  dtype=dtype, attention_impl=attention_impl,
                  max_len=max(GPT2_CTX, max_len or 0), remat=remat)
+
+
+def gpt2_moe(num_classes: int = 0, dtype=jnp.float32,
+             attention_impl: str = "dense", max_len: int | None = None,
+             remat: bool = False):
+    """GPT-2-small trunk with 8-expert top-2 MoE FFNs (~520M params,
+    ~124M active per token) — the expert-parallel workload."""
+    del num_classes
+    return GPTLM(dtype=dtype, attention_impl=attention_impl,
+                 max_len=max(GPT2_CTX, max_len or 0), remat=remat,
+                 num_experts=8, top_k=2)
+
+
+def moe_tiny(num_classes: int = 0, dtype=jnp.float32,
+             attention_impl: str = "dense", max_len: int | None = None,
+             remat: bool = False):
+    """4-layer/128-hidden 4-expert decoder for tests and CPU smoke runs."""
+    del num_classes
+    return GPTLM(vocab_size=1024, hidden=128, num_layers=4, heads=4,
+                 ffn=256, dtype=dtype, attention_impl=attention_impl,
+                 max_len=max(128, max_len or 0), remat=remat,
+                 num_experts=4, top_k=2)

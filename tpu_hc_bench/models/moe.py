@@ -1,0 +1,125 @@
+"""Mixture-of-Experts FFN + expert parallelism (GShard/Switch style).
+
+Beyond-reference capability (the reference is DP-only, SURVEY.md §2c —
+expert parallelism listed "absent"): sparse MoE layers for the decoder
+family, designed TPU-first.
+
+The dispatch is the classic GShard einsum formulation: per-group (= per
+batch row) top-k routing builds dense ``dispatch``/``combine`` tensors of
+shape ``[B, S, E, C]`` (C = expert capacity), and all data movement is
+einsum contractions — no gather/scatter, no dynamic shapes, every op lands
+on the MXU.  Expert parallelism is pure GSPMD: the expert-major parameter
+tensors ``wi [E, H, F]`` / ``wo [E, F, H]`` are sharded over the mesh
+"model" axis (``train.step.tp_param_spec`` rules), tokens stay sharded
+over "data", and XLA's SPMD partitioner inserts the expert all-to-alls for
+the ``[E, ...]``-sharded einsums itself — the same GSPMD arm the tensor-
+parallel path rides (``--expert_parallel`` ↦ mesh model axis).
+
+Router details: router logits in float32 (softmax stability under bf16
+params); top-k selection by iterative argmax masking; capacity overflow
+tokens are dropped (their combine weight is zero, the residual connection
+carries them through — standard Switch behavior); the Switch load-balance
+auxiliary loss is sown into the ``"losses"`` collection and picked up by
+``train.step._loss_and_updates``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# Switch-Transformer convention: aux = E * Σ_e f_e · p̄_e, weighted into the
+# total loss at this coefficient (Fedus et al. use 1e-2).
+AUX_LOSS_COEF = 0.01
+
+
+def top_k_routing(probs: jax.Array, top_k: int, capacity: int):
+    """Build dispatch/combine tensors from router probabilities.
+
+    ``probs``: [B, S, E] float32 router softmax.  Returns
+    ``(dispatch [B,S,E,C] bool-ish float, combine [B,S,E,C] float32,
+    aux_loss scalar)``.  Routing is per-group (group = batch row): each
+    expert accepts at most ``capacity`` tokens *per group*, assigned in
+    sequence order with earlier-k choices taking priority (GShard's
+    position-in-expert cumsum).
+    """
+    b, s, e = probs.shape
+    masks, gates = [], []
+    p = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)                    # [B, S]
+        mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)  # [B, S, E]
+        gates.append((p * mask).sum(-1))                # [B, S]
+        masks.append(mask)
+        p = p * (1.0 - mask)
+
+    # Switch aux loss from the k=0 assignment (pre-capacity): fraction of
+    # tokens routed to each expert x mean router prob, summed, scaled by E.
+    frac = masks[0].mean(axis=(0, 1))                   # [E]
+    mean_prob = probs.mean(axis=(0, 1))                 # [E]
+    aux_loss = e * jnp.sum(frac * mean_prob)
+
+    # normalize the selected gates to sum to 1 per token (top-2 convention)
+    denom = jnp.maximum(sum(gates), 1e-9)
+    gates = [g / denom for g in gates]
+
+    dispatch = jnp.zeros((b, s, e, capacity), probs.dtype)
+    combine = jnp.zeros((b, s, e, capacity), probs.dtype)
+    offset = jnp.zeros((b, 1, e), probs.dtype)
+    for mask, gate in zip(masks, gates):
+        # position of each token within its expert's queue (per group)
+        pos = jnp.cumsum(mask, axis=1) - mask + offset   # [B, S, E]
+        offset = offset + mask.sum(axis=1, keepdims=True)
+        mask = mask * (pos < capacity)                   # drop overflow
+        pos_tok = (pos * mask).sum(-1).astype(jnp.int32)  # [B, S]
+        slot = jax.nn.one_hot(pos_tok, capacity, dtype=probs.dtype)
+        placed = mask[..., None] * slot[:, :, None, :]   # [B, S, E, C]
+        dispatch = dispatch + placed
+        combine = combine + gate[..., None, None] * placed
+    return dispatch, combine, aux_loss
+
+
+class MoEFFN(nn.Module):
+    """Sparse MoE feed-forward block: drop-in for a transformer's dense FFN.
+
+    Expert-major params (``wi [E, H, F]``, ``wo [E, F, H]``) so expert
+    parallelism is a single leading-dim PartitionSpec.  All dispatch math
+    is einsum; activations follow ``dtype`` (bf16-safe), router in f32.
+    """
+
+    hidden: int
+    ffn: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, h = x.shape
+        e = self.num_experts
+        # per-group (= per batch row) expert capacity, floor of 4 slots
+        import math
+
+        capacity = max(4, math.ceil(self.capacity_factor * self.top_k * s / e))
+
+        router = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router")
+        probs = jax.nn.softmax(router(x.astype(jnp.float32)), axis=-1)
+        dispatch, combine, aux = top_k_routing(probs, self.top_k, capacity)
+        self.sow("losses", "moe_aux", aux)
+
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        wi = self.param("wi", init, (e, h, self.ffn))
+        wo = self.param("wo", init, (e, self.ffn, h))
+
+        xin = jnp.einsum("bsec,bsh->ebch", dispatch.astype(self.dtype),
+                         x.astype(self.dtype))
+        act = nn.gelu(jnp.einsum("ebch,ehf->ebcf", xin,
+                                 wi.astype(self.dtype)))
+        out = jnp.einsum("ebcf,efh->ebch", act, wo.astype(self.dtype))
+        y = jnp.einsum("bsec,ebch->bsh", combine.astype(self.dtype), out)
+        return y.astype(x.dtype)

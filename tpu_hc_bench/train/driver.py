@@ -292,16 +292,19 @@ def run_benchmark(
 
     fab = fabric_mod.resolve_fabric(fabric_name)
     layout = layout or discover_layout()
-    mp = max(1, cfg.model_parallel)
+    # model_parallel (TP) and expert_parallel (EP) both shard over the mesh
+    # "model" axis; resolve() enforces their exclusivity
+    mp = max(1, cfg.model_parallel, getattr(cfg, "expert_parallel", 1))
     if layout.total_workers % mp:
         raise ValueError(
-            f"--model_parallel={mp} does not divide "
+            f"--model_parallel/--expert_parallel={mp} does not divide "
             f"{layout.total_workers} workers"
         )
     if mp > 1 and fab is fabric_mod.Fabric.HOST:
         raise ValueError(
-            "--model_parallel requires a device fabric (ici/dcn): the host "
-            "path's shard_map would silently re-replicate the TP shards"
+            "--model_parallel/--expert_parallel requires a device fabric "
+            "(ici/dcn): the host path's shard_map would silently "
+            "re-replicate the shards"
         )
     mesh = build_mesh(layout, model_parallel=mp)
     # with TP, the data-parallel degree (and so the global batch at fixed
@@ -390,7 +393,8 @@ def run_benchmark(
     # --- state + step ---
     state = step_mod.make_train_state(model, cfg, batch)
     if mp > 1:
-        state = step_mod.shard_state_tp(state, mesh)
+        mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
+        state = step_mod.shard_state_tp(state, mesh, mode)
     else:
         state = step_mod.replicate_state(state, mesh)
     batch_iter = batches()

@@ -116,14 +116,14 @@ def _loss_and_updates(state: TrainState, params, batch, dropout_rng,
         variables["batch_stats"] = state.batch_stats
     rngs = {"dropout": dropout_rng}
     inputs = prep_inputs(batch[0])
-    if has_stats:
-        logits, updated = state.apply_fn(
-            variables, inputs, train=True, rngs=rngs, mutable=["batch_stats"]
-        )
-        new_stats = updated["batch_stats"]
-    else:
-        logits = state.apply_fn(variables, inputs, train=True, rngs=rngs)
-        new_stats = {}
+    # "losses" collects sown auxiliary terms (MoE load-balance); models
+    # without them just return an empty dict
+    mutable = (["batch_stats"] if has_stats else []) + ["losses"]
+    logits, updated = state.apply_fn(
+        variables, inputs, train=True, rngs=rngs, mutable=mutable
+    )
+    new_stats = updated.get("batch_stats", {})
+    aux_terms = jax.tree.leaves(updated.get("losses", {}))
     if is_text:
         _, targets, weights = batch
         if fused_xent:
@@ -144,6 +144,10 @@ def _loss_and_updates(state: TrainState, params, batch, dropout_rng,
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels
         ).mean()
+    if aux_terms:
+        from tpu_hc_bench.models.moe import AUX_LOSS_COEF
+
+        loss = loss + AUX_LOSS_COEF * sum(jnp.sum(t) for t in aux_terms)
     return loss, new_stats
 
 
@@ -163,8 +167,9 @@ def build_train_step(
 
     if fab is fabric_mod.Fabric.HOST:
         return _build_host_step(mesh, cfg, is_text)
-    if getattr(cfg, "model_parallel", 1) > 1:
-        # TP runs on the GSPMD arm: params enter committed with
+    if (getattr(cfg, "model_parallel", 1) > 1
+            or getattr(cfg, "expert_parallel", 1) > 1):
+        # TP/EP run on the GSPMD arm: params enter committed with
         # tp_param_spec shardings and jit follows them
         return _build_gspmd_step(mesh, cfg, is_text, follow_inputs=True)
     if cfg.variable_update == "replicated":
@@ -381,7 +386,7 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
     return jax.jit(shard_fn)
 
 
-def tp_param_spec(path: str, ndim: int) -> P:
+def tp_param_spec(path: str, ndim: int, mode: str = "tp") -> P:
     """Megatron-style tensor-parallel PartitionSpec for a transformer param.
 
     Column-parallel QKV/FFN-in (shard the output features over the model
@@ -393,6 +398,11 @@ def tp_param_spec(path: str, ndim: int) -> P:
 
     Matches both naming schemes: BERT's anonymous FFN denses
     (``Dense_0``/``Dense_1``) and GPT's ``fc``/``proj``.
+
+    ``mode="ep"`` (``--expert_parallel``) restricts the rules to the MoE
+    expert tensors: whole experts shard over the model axis, the dense
+    trunk (attention, norms, embeddings) stays replicated — pure expert
+    parallelism rather than the TP+EP hybrid.
     """
     from tpu_hc_bench.topology import MODEL_AXIS as M
 
@@ -406,32 +416,49 @@ def tp_param_spec(path: str, ndim: int) -> P:
         ("fc/kernel", P(None, M)),
         ("fc/bias", P(M)),
         ("proj/kernel", P(M, None)),
+        # expert parallelism: whole experts live on model-axis shards
+        # (models/moe.py wi [E, H, F] / wo [E, F, H]); GSPMD turns the
+        # [E]-sharded dispatch/combine einsums into expert all-to-alls
+        ("moe/wi", P(M, None, None)),
+        ("moe/wo", P(M, None, None)),
     ]
+    if mode == "ep":
+        rules = [r for r in rules if r[0].startswith("moe/")]
     for suffix, spec in rules:
         if path.endswith(suffix) and len(spec) == ndim:
             return spec
     return P()
 
 
-def _param_specs(params) -> dict:
+def _param_specs(params, mode: str = "tp") -> dict:
     """Pytree of PartitionSpecs matching ``params`` via tp_param_spec."""
     return jax.tree_util.tree_map_with_path(
         lambda path, v: tp_param_spec(
-            "/".join(getattr(k, "key", str(k)) for k in path), v.ndim
+            "/".join(getattr(k, "key", str(k)) for k in path), v.ndim, mode
         ),
         params,
     )
 
 
-def shard_state_tp(state: TrainState, mesh: Mesh) -> TrainState:
-    """Place the state with tensor-parallel param shardings.
+def shard_state_tp(state: TrainState, mesh: Mesh,
+                   mode: str = "tp") -> TrainState:
+    """Place the state with tensor/expert-parallel param shardings.
 
     Params (and the optimizer state, which mirrors the param tree — e.g.
     the momentum trace) are sharded per ``tp_param_spec``; everything else
     replicates.  The jitted GSPMD step then *follows* these committed
-    shardings, so the same ``_build_gspmd_step`` serves DP and DP x TP.
+    shardings, so the same ``_build_gspmd_step`` serves DP, DP x TP, and
+    DP x EP (``mode="ep"``).
     """
-    specs = _param_specs(state.params)
+    specs = _param_specs(state.params, mode)
+    if mode == "ep" and not any(
+        s != P() for s in jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    ):
+        raise ValueError(
+            "expert_parallel > 1 but no param matched an expert rule: the "
+            "model has no MoE layers (use an moe member, e.g. gpt2_moe), "
+            "so EP would only halve the data-parallel degree"
+        )
 
     def put(spec_tree, tree):
         return jax.tree.map(
