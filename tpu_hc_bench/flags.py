@@ -134,6 +134,12 @@ class BenchmarkConfig:
                                               # mesh "model" axis (GSPMD
                                               # all-to-all dispatch);
                                               # exclusive with model_parallel
+    pipeline_parallel: int = 1                # pipeline stages over the mesh
+                                              # "pipe" axis (GPipe
+                                              # microbatching via ppermute;
+                                              # GPT decoder family)
+    num_microbatches: int = 0                 # GPipe microbatches per step
+                                              # (0 -> 2x pipeline stages)
     virtual_devices: int | None = None        # debug: provision N virtual
                                               # CPU devices (multi-chip
                                               # paths without hardware)
@@ -185,6 +191,12 @@ class BenchmarkConfig:
             raise ValueError(
                 "--model_parallel and --expert_parallel are exclusive: both "
                 "shard over the mesh 'model' axis"
+            )
+        if self.pipeline_parallel > 1 and (
+                self.model_parallel > 1 or self.expert_parallel > 1):
+            raise ValueError(
+                "--pipeline_parallel cannot be combined with "
+                "--model_parallel/--expert_parallel on the 2-D mesh"
             )
         sharded = max(self.model_parallel, self.expert_parallel)
         if sharded > 1 and self.variable_update != "replicated":
@@ -268,6 +280,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "uint8"])
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
     p.add_argument("--expert_parallel", type=int, default=d.expert_parallel)
+    p.add_argument("--pipeline_parallel", type=int,
+                   default=d.pipeline_parallel)
+    p.add_argument("--num_microbatches", type=int, default=d.num_microbatches)
     p.add_argument("--virtual_devices", type=int, default=d.virtual_devices)
     p.add_argument("--gradient_checkpointing", type=_parse_bool,
                    default=d.gradient_checkpointing)

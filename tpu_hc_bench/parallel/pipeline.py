@@ -1,0 +1,259 @@
+"""Pipeline parallelism: GPipe microbatching over a ``pipe`` mesh axis.
+
+Beyond-reference capability (the reference is DP-only, SURVEY.md §2c —
+pipeline parallelism listed "absent"), built the TPU way: the schedule is
+a ``lax.scan`` whose carried activations hop stage-to-stage with
+``ppermute`` (neighbor ICI transfers), so the whole pipeline — bubbles,
+stage compute, inter-stage sends — compiles into ONE XLA program per
+training step.  The backward schedule is not hand-written: JAX transposes
+the forward scan, turning each ``ppermute`` into its reverse hop, which
+*is* GPipe's backward pass.
+
+Layer-to-stage mapping reuses the GPT decoder family's parameter tree
+verbatim: ``stack_layer_params`` stacks the ``layer_i`` subtrees into one
+``[L, ...]`` pytree whose leading dim shards over the pipe axis
+(``L / n_pipe`` layers per stage, applied with an inner ``lax.scan`` —
+scan-over-layers).  Embedding and head replicate and run on every stage;
+gating + the gradient psums below keep the math exactly equal to the
+unsharded model (tested in tests/test_pipeline.py).
+
+Gradient bookkeeping (the subtle part): the device-local loss is
+``pmean``-ed over BOTH mesh axes inside the loss function, so for the
+total objective J each rank's autodiff produces its *partial* dJ/dparam.
+Stage-sharded layer params receive their full gradient locally (every
+rank's loss routes through every stage exactly once), so they psum over
+``data`` only; replicated embed/head params psum over ``data`` AND
+``pipe`` — the embedding contribution lives on pipe-rank 0 (input gate),
+the head contribution is 1/n on every rank (all ranks compute the head on
+the broadcast pipeline output), and the tied-embedding case is the sum of
+both, which one psum delivers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_hc_bench.topology import DATA_AXIS, PIPE_AXIS
+
+
+def pipeline_apply(block_fn, stage_params, x_mb, axis_name: str = PIPE_AXIS):
+    """Run microbatches through the pipeline; must be inside shard_map.
+
+    ``block_fn(layer_params, h) -> h`` applies ONE layer.  ``stage_params``
+    is this stage's ``[L_local, ...]`` stacked layer pytree.  ``x_mb`` is
+    ``[M, mb, ...]`` microbatched activations, replicated over the pipe
+    axis (only stage 0 reads them).  Returns ``[M, mb, ...]`` pipeline
+    outputs, identical on every stage (psum-broadcast from the last).
+
+    The scan runs ``M + n - 1`` ticks (GPipe fill + drain); at tick t,
+    stage 0 injects microbatch t, stage ``s`` works on microbatch
+    ``t - s``, and the last stage retires microbatch ``t - (n-1)``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    num_mb = x_mb.shape[0]
+
+    def stage_apply(h):
+        def body(h, p):
+            return block_fn(p, h), None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    state0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+
+    def tick(carry, t):
+        state, outputs = carry
+        mb_in = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, num_mb - 1), 0, keepdims=False)
+        h = jnp.where(idx == 0, mb_in, state)
+        y = stage_apply(h)
+        t_out = t - (n - 1)
+        o_idx = jnp.clip(t_out, 0, num_mb - 1)
+        cur = jax.lax.dynamic_index_in_dim(outputs, o_idx, 0, keepdims=False)
+        retired = jnp.where((idx == n - 1) & (t_out >= 0), y, cur)
+        outputs = jax.lax.dynamic_update_index_in_dim(outputs, retired,
+                                                      o_idx, 0)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state0, out0), jnp.arange(num_mb + n - 1))
+    # broadcast the retired outputs from the last stage to every stage
+    outputs = jax.lax.psum(
+        jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)), axis_name)
+    return outputs
+
+
+def stack_layer_params(params: dict, num_layers: int) -> dict:
+    """GPT param tree -> {'trunk': [L, ...] stacked layers, <rest>}."""
+    layers = [params[f"layer_{i}"] for i in range(num_layers)]
+    rest = {k: v for k, v in params.items() if not k.startswith("layer_")}
+    rest["trunk"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return rest
+
+
+def unstack_layer_params(params: dict, num_layers: int) -> dict:
+    """Inverse of ``stack_layer_params`` (checkpoint interchange)."""
+    out = {k: v for k, v in params.items() if k != "trunk"}
+    for i in range(num_layers):
+        out[f"layer_{i}"] = jax.tree.map(lambda x: x[i], params["trunk"])
+    return out
+
+
+def pp_param_specs(params: dict) -> dict:
+    """trunk shards its leading (layer) dim over pipe; the rest replicates."""
+    return {
+        k: jax.tree.map(
+            lambda x: P(PIPE_AXIS, *(None,) * (x.ndim - 1)) if k == "trunk"
+            else P(), v)
+        for k, v in params.items()
+    }
+
+
+def _opt_specs(opt_state, param_specs: dict, params: dict):
+    """Specs for the optimizer state: param-shaped subtrees (momentum
+    trace) inherit the param specs, everything else replicates."""
+    pstruct = jax.tree.structure(params)
+
+    def per_node(node):
+        if jax.tree.structure(node) == pstruct:
+            return param_specs
+        return jax.tree.map(lambda _: P(), node)
+
+    return jax.tree.map(
+        per_node, opt_state,
+        is_leaf=lambda n: jax.tree.structure(n) == pstruct)
+
+
+def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
+                        example_params: dict, example_opt_state):
+    """DP x PP training step for the GPT decoder family.
+
+    ``model`` is a ``GPTLM`` whose params have been restacked with
+    ``stack_layer_params``.  The step is a ``shard_map`` over the
+    ``(data, pipe)`` mesh: batch sharded over data, trunk sharded over
+    pipe, embed/head replicated.  Forward matches ``GPTLM.__call__`` with
+    ``train=False`` exactly (embed + pos, pipelined pre-LN decoder layers,
+    final LN, tied f32 output projection); MoE aux losses are not
+    collected on this path (immutable apply drops the sow).
+    """
+    from flax import linen as nn
+
+    from tpu_hc_bench.models.gpt import DecoderLayer
+    from tpu_hc_bench.train.step import make_optimizer
+
+    layer = DecoderLayer(model.hidden, model.heads, model.ffn,
+                         dtype=model.dtype, num_experts=model.num_experts,
+                         top_k=model.top_k,
+                         attention_impl=model.attention_impl)
+    ln_f = nn.LayerNorm(dtype=model.dtype)
+    tx = make_optimizer(cfg)
+
+    def block_fn(p, h):
+        return layer.apply({"params": p}, h, False)
+
+    if model.remat:
+        # --gradient_checkpointing: recompute each layer in the backward
+        block_fn = jax.checkpoint(block_fn)
+
+    def forward(params, tokens):
+        wte = params["wte"]["embedding"]
+        wpe = params["wpe"]["embedding"]
+        b, s = tokens.shape
+        x = (wte.astype(model.dtype)[tokens]
+             + wpe.astype(model.dtype)[jnp.arange(s)][None])
+        mb = b // num_microbatches
+        xs = x.reshape(num_microbatches, mb, s, model.hidden)
+        ys = pipeline_apply(block_fn, params["trunk"], xs)
+        x = ys.reshape(b, s, model.hidden)
+        x = ln_f.apply({"params": params["ln_f"]}, x)
+        return jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
+                          wte.astype(jnp.float32))
+
+    def device_step(params, opt_state, batch):
+        tokens, targets, weights = batch
+        n_pipe = jax.lax.axis_size(PIPE_AXIS)
+        is_last = jax.lax.axis_index(PIPE_AXIS) == n_pipe - 1
+
+        def loss_fn(p):
+            logits = forward(p, tokens)
+            losses = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets)
+            loss = (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+            # every pipe rank computes the head on the broadcast pipeline
+            # output, but only the LAST stage's loss is "real": gating it
+            # makes exactly one backward seed enter the shared pipeline per
+            # data column, so no cotangent is double-counted regardless of
+            # psum-transpose semantics
+            return jnp.where(is_last, loss, 0.0)
+
+        if cfg.forward_only:
+            loss = loss_fn(params)
+            loss = jax.lax.pmean(jax.lax.psum(loss, PIPE_AXIS), DATA_AXIS)
+            return params, opt_state, loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # stage-sharded trunk: each rank holds its own stages' full grads
+        # -> average over data columns only.  Replicated embed/head: the
+        # contributions live on single pipe ranks (embedding via the
+        # stage-0 input gate, head/ln_f via the gated last-stage loss; the
+        # tied embedding is the sum of both) -> collect with a pipe psum,
+        # then average over data.
+        grads = {
+            k: jax.tree.map(
+                lambda g: jax.lax.pmean(
+                    g if k == "trunk" else jax.lax.psum(g, PIPE_AXIS),
+                    DATA_AXIS),
+                v)
+            for k, v in grads.items()
+        }
+        loss = jax.lax.pmean(jax.lax.psum(loss, PIPE_AXIS), DATA_AXIS)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    pspecs = pp_param_specs(example_params)
+    ospecs = _opt_specs(example_opt_state, pspecs, example_params)
+    shard_fn = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(pspecs, ospecs, P(DATA_AXIS)),
+        out_specs=(pspecs, ospecs, P()),
+        check_vma=False,
+    )
+    jitted = jax.jit(shard_fn, donate_argnums=(0, 1))
+
+    def step(params, opt_state, batch):
+        return jitted(params, opt_state, batch)
+
+    return step, tx
+
+
+def make_pp_state(model, cfg, example_tokens, mesh: Mesh):
+    """Init GPTLM params, restack layers for the pipe axis, init SGD.
+
+    Returns ``(params, opt_state)`` placed on the mesh (trunk sharded over
+    pipe, everything else replicated).
+    """
+    from tpu_hc_bench.train.step import make_optimizer
+
+    init_fn = jax.jit(functools.partial(model.init, train=False))
+    variables = init_fn(
+        {"params": jax.random.PRNGKey(cfg.seed),
+         "dropout": jax.random.PRNGKey(cfg.seed + 1)},
+        jnp.asarray(example_tokens[:1]),
+    )
+    params = stack_layer_params(variables["params"], model.num_layers)
+    tx = make_optimizer(cfg)
+    opt_state = tx.init(params)
+    pspecs = pp_param_specs(params)
+    ospecs = _opt_specs(opt_state, pspecs, params)
+    put = lambda tree, specs: jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
+    return put(params, pspecs), put(opt_state, ospecs)

@@ -35,6 +35,7 @@ from jax.sharding import Mesh
 # not preclude tensor/pipeline/sequence sharding later.
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,13 +142,18 @@ def build_mesh(
     layout: Layout,
     devices: Sequence[jax.Device] | None = None,
     model_parallel: int = 1,
+    pipeline_parallel: int = 1,
 ) -> Mesh:
     """Build the device mesh for this layout.
 
     DP-only (reference parity) gives a 1-D ``("data",)`` mesh.  Passing
     ``model_parallel > 1`` folds the trailing chips of each host into a
-    ``("data", "model")`` mesh so the same builder serves hybrid sharding
-    later without changing callers (SURVEY.md §2c implication).
+    ``("data", "model")`` mesh (tensor/expert parallelism);
+    ``pipeline_parallel > 1`` a ``("data", "pipe")`` mesh — so the same
+    builder serves hybrid sharding without changing callers (SURVEY.md
+    §2c implication).  The minor axis gets adjacent chips: TP/EP/PP
+    collectives (all-reduce, all-to-all, stage ppermute hops) ride
+    neighbor ICI links.
 
     Device order: host-major, chip-minor — the data axis crosses hosts last,
     so intra-host ICI carries the short allreduce hops and DCN only the
@@ -156,9 +162,16 @@ def build_mesh(
     """
     import numpy as np
 
+    if model_parallel > 1 and pipeline_parallel > 1:
+        raise ValueError(
+            "model_parallel and pipeline_parallel cannot be combined "
+            "on the 2-D mesh (pick one minor axis)")
+    minor = max(model_parallel, pipeline_parallel)
+    minor_name = PIPE_AXIS if pipeline_parallel > 1 else MODEL_AXIS
     picked = select_devices(layout, devices)
     n = len(picked)
-    if n % model_parallel:
-        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
-    arr = np.array(picked, dtype=object).reshape(n // model_parallel, model_parallel)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    if n % minor:
+        raise ValueError(
+            f"{n} devices not divisible by {minor_name}_parallel={minor}")
+    arr = np.array(picked, dtype=object).reshape(n // minor, minor)
+    return Mesh(arr, (DATA_AXIS, minor_name))

@@ -292,23 +292,25 @@ def run_benchmark(
 
     fab = fabric_mod.resolve_fabric(fabric_name)
     layout = layout or discover_layout()
-    # model_parallel (TP) and expert_parallel (EP) both shard over the mesh
-    # "model" axis; resolve() enforces their exclusivity
-    mp = max(1, cfg.model_parallel, getattr(cfg, "expert_parallel", 1))
+    # model_parallel (TP), expert_parallel (EP), and pipeline_parallel (PP)
+    # all claim the mesh's minor axis; resolve() enforces their exclusivity
+    pp = max(1, getattr(cfg, "pipeline_parallel", 1))
+    mp = max(1, cfg.model_parallel, getattr(cfg, "expert_parallel", 1), pp)
     if layout.total_workers % mp:
         raise ValueError(
-            f"--model_parallel/--expert_parallel={mp} does not divide "
-            f"{layout.total_workers} workers"
+            f"--model_parallel/--expert_parallel/--pipeline_parallel={mp} "
+            f"does not divide {layout.total_workers} workers"
         )
     if mp > 1 and fab is fabric_mod.Fabric.HOST:
         raise ValueError(
-            "--model_parallel/--expert_parallel requires a device fabric "
-            "(ici/dcn): the host path's shard_map would silently "
-            "re-replicate the shards"
+            "--model_parallel/--expert_parallel/--pipeline_parallel "
+            "requires a device fabric (ici/dcn): the host path's shard_map "
+            "would silently re-replicate the shards"
         )
-    mesh = build_mesh(layout, model_parallel=mp)
-    # with TP, the data-parallel degree (and so the global batch at fixed
-    # per-worker batch) shrinks by the TP degree
+    mesh = build_mesh(layout, model_parallel=mp if pp == 1 else 1,
+                      pipeline_parallel=pp)
+    # with TP/EP/PP, the data-parallel degree (and so the global batch at
+    # fixed per-worker batch) shrinks by the minor-axis degree
     global_batch = layout.global_batch(cfg.batch_size) // mp
 
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
@@ -391,21 +393,55 @@ def run_benchmark(
                 yield dev_batch
 
     # --- state + step ---
-    state = step_mod.make_train_state(model, cfg, batch)
-    if mp > 1:
-        mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
-        state = step_mod.shard_state_tp(state, mesh, mode)
+    if pp > 1:
+        if cfg.eval:
+            raise ValueError("--eval with --pipeline_parallel is not supported")
+        if not spec.causal_lm:
+            raise ValueError(
+                "--pipeline_parallel currently supports the GPT decoder "
+                f"family (causal LM), not {cfg.model}")
+        from tpu_hc_bench.parallel import pipeline as pipe_mod
+
+        if model.num_layers % pp:
+            raise ValueError(
+                f"{cfg.model}: {model.num_layers} layers not divisible by "
+                f"pipeline_parallel={pp}")
+        num_mb = cfg.num_microbatches or (
+            2 * pp if cfg.batch_size % (2 * pp) == 0 else pp)
+        if cfg.batch_size % num_mb:
+            raise ValueError(
+                f"per-worker batch {cfg.batch_size} not divisible by "
+                f"num_microbatches={num_mb}")
+        print_fn(f"pipeline: {pp} stages x {num_mb} microbatches "
+                 f"({model.num_layers // pp} layers/stage)")
+        params, opt_state = pipe_mod.make_pp_state(model, cfg, batch[0], mesh)
+        pp_step, _ = pipe_mod.build_pp_train_step(
+            mesh, model, cfg, num_mb, params, opt_state)
+        state = (params, opt_state)
+
+        def train_step(state, batch, rng):
+            del rng  # PP forward runs layers deterministic (no dropout)
+            new_params, new_opt, loss = pp_step(*state, batch)
+            return (new_params, new_opt), {"loss": loss}
+
+        batch_iter = batches()
     else:
-        state = step_mod.replicate_state(state, mesh)
-    batch_iter = batches()
-    if cfg.eval:
+        state = step_mod.make_train_state(model, cfg, batch)
         if mp > 1:
-            raise ValueError("--eval with --model_parallel is not supported")
-        return _run_eval(
-            cfg, spec, layout, mesh, state, batch_iter, global_batch,
-            fab, print_fn,
-        )
-    train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
+            mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
+            state = step_mod.shard_state_tp(state, mesh, mode)
+        else:
+            state = step_mod.replicate_state(state, mesh)
+        batch_iter = batches()
+        if cfg.eval:
+            if mp > 1:
+                raise ValueError(
+                    "--eval with --model_parallel is not supported")
+            return _run_eval(
+                cfg, spec, layout, mesh, state, batch_iter, global_batch,
+                fab, print_fn,
+            )
+        train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
     rng = jax.random.PRNGKey(cfg.seed + 17)
 
     # --- warmup (includes compile; reference warmup=50, :32) ---
