@@ -82,7 +82,7 @@ def test_pp_matches_unsharded(devices, num_microbatches):
     tx = optax.sgd(cfg.init_learning_rate, momentum=cfg.momentum)
     opt_state = tx.init(params)
     step, _ = pp.build_pp_train_step(mesh, model, cfg, num_microbatches,
-                                     params, opt_state)
+                                     params, opt_state, deterministic=True)
     new_params, new_opt, loss = step(params, opt_state, batch)
 
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
@@ -105,6 +105,65 @@ def test_pp_state_placement(devices):
     assert spec[0] == PIPE_AXIS
     assert params["wte"]["embedding"].sharding.spec == \
         jax.sharding.PartitionSpec()
+
+
+def test_pp_moe_aux_matches_unsharded(devices):
+    """PP with MoE layers must include the Switch aux loss exactly as the
+    unsharded model does (per-microbatch-mean == batch-mean because
+    routing groups are batch rows)."""
+    from tpu_hc_bench.models.moe import AUX_LOSS_COEF
+
+    model = GPTLM(vocab_size=256, hidden=32, num_layers=4, heads=4, ffn=64,
+                  max_len=32, num_experts=4, top_k=2)
+    cfg = flags.BenchmarkConfig(model="gpt2", batch_size=1,
+                                pipeline_parallel=4).resolve()
+    batch = _batch()
+    tokens, targets, weights = batch
+    base_params = model.init(jax.random.PRNGKey(0), tokens[:1],
+                             train=False)["params"]
+
+    # unsharded reference task loss (attention/loss are per-row, so the
+    # full-batch forward matches any grouping)
+    logits = model.apply({"params": base_params}, tokens, train=False)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    task_ref = (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    # the Switch aux is a *per-group statistic* (product of two means, not
+    # linear), so the reference must use the same 2-row microbatch groups
+    # the (data=2, pipe=4, num_mb=2) run produces: mean over groups
+    aux_groups = []
+    for g in range(0, tokens.shape[0], 2):
+        _, upd = model.apply({"params": base_params}, tokens[g:g + 2],
+                             train=False, mutable=["losses"])
+        aux_groups.append(
+            sum(jnp.sum(t) for t in jax.tree.leaves(upd["losses"])))
+    aux_ref = float(np.mean([float(a) for a in aux_groups]))
+    assert aux_ref > 0.0
+    ref = float(task_ref) + AUX_LOSS_COEF * aux_ref
+
+    mesh = build_mesh(compute_layout(1, 8, 8), pipeline_parallel=4)
+    params = pp.stack_layer_params(base_params, model.num_layers)
+    tx = optax.sgd(cfg.init_learning_rate, momentum=cfg.momentum)
+    opt_state = tx.init(params)
+    step, _ = pp.build_pp_train_step(mesh, model, cfg, 2, params, opt_state,
+                                     deterministic=True)
+    _, _, loss = step(params, opt_state, batch)
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_pp_dropout_mode_trains(devices):
+    """Non-deterministic PP (dropout active) runs and changes params."""
+    model = _tiny_model()
+    cfg = flags.BenchmarkConfig(model="gpt2", batch_size=1,
+                                pipeline_parallel=4).resolve()
+    batch = _batch()
+    mesh = build_mesh(compute_layout(1, 8, 8), pipeline_parallel=4)
+    params, opt_state = pp.make_pp_state(model, cfg, batch[0], mesh)
+    before = float(jnp.abs(params["wte"]["embedding"]).sum())
+    step, _ = pp.build_pp_train_step(mesh, model, cfg, 2, params, opt_state)
+    params, opt_state, loss = step(params, opt_state, batch,
+                                   jax.random.PRNGKey(7))
+    assert np.isfinite(float(loss))
+    assert float(jnp.abs(params["wte"]["embedding"]).sum()) != before
 
 
 def test_pp_flag_exclusivity():

@@ -212,6 +212,28 @@ def test_driver_eval_mode(mesh8):
     assert res.total_images_per_sec > 0
 
 
+def test_driver_expert_parallel(mesh8):
+    """--expert_parallel end-to-end through run_benchmark (DP x EP)."""
+    cfg = tiny_cfg(model="moe_tiny", expert_parallel=2, batch_size=2,
+                   num_batches=2)
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    assert "expert_parallel=2" in "\n".join(out)
+    assert res.total_images_per_sec > 0
+    assert np.isfinite(res.final_loss)
+
+
+def test_driver_pipeline_parallel(mesh8):
+    """--pipeline_parallel end-to-end through run_benchmark (DP x PP)."""
+    cfg = tiny_cfg(model="moe_tiny", pipeline_parallel=4, batch_size=4,
+                   num_batches=2)
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    assert "pipeline: 4 stages" in "\n".join(out)
+    assert res.total_images_per_sec > 0
+    assert np.isfinite(res.final_loss)
+
+
 def test_log_name_convention():
     # reference: tfmn-<n>n-<b>b-<data>-<fabric>-r<run>.log (:9-12)
     assert driver.log_name(4, 64, "synthetic", "ici", 1) == \

@@ -198,6 +198,12 @@ class BenchmarkConfig:
                 "--pipeline_parallel cannot be combined with "
                 "--model_parallel/--expert_parallel on the 2-D mesh"
             )
+        if self.pipeline_parallel > 1:
+            t["variable_update"] = (
+                f"{self.variable_update}->n/a (pipeline_parallel="
+                f"{self.pipeline_parallel} runs the dedicated GPipe "
+                f"shard_map step with its own gradient psums)"
+            )
         sharded = max(self.model_parallel, self.expert_parallel)
         if sharded > 1 and self.variable_update != "replicated":
             which = ("model_parallel" if self.model_parallel > 1
@@ -225,7 +231,10 @@ class BenchmarkConfig:
             + (f" model_parallel={self.model_parallel}"
                if self.model_parallel > 1 else "")
             + (f" expert_parallel={self.expert_parallel}"
-               if self.expert_parallel > 1 else ""),
+               if self.expert_parallel > 1 else "")
+            + (f" pipeline_parallel={self.pipeline_parallel}"
+               f" num_microbatches={self.num_microbatches or 'auto'}"
+               if self.pipeline_parallel > 1 else ""),
         ]
         for k, v in self.translations.items():
             lines.append(f"translated: {k}: {v}")

@@ -111,15 +111,20 @@ class MoEFFN(nn.Module):
         probs = jax.nn.softmax(router(x.astype(jnp.float32)), axis=-1)
         dispatch, combine, aux = top_k_routing(probs, self.top_k, capacity)
         self.sow("losses", "moe_aux", aux)
+        # the [B,S,E,C] dispatch/combine tensors dominate the layer's
+        # activation memory (they are saved for backward); store them in
+        # the compute dtype — dispatch is 0/1 exactly, combine gates lose
+        # only bf16 rounding on weights the router learned in f32
+        dispatch = dispatch.astype(self.dtype)
+        combine = combine.astype(self.dtype)
 
         init = nn.initializers.lecun_normal(batch_axis=(0,))
         wi = self.param("wi", init, (e, h, self.ffn))
         wo = self.param("wo", init, (e, self.ffn, h))
 
-        xin = jnp.einsum("bsec,bsh->ebch", dispatch.astype(self.dtype),
-                         x.astype(self.dtype))
+        xin = jnp.einsum("bsec,bsh->ebch", dispatch, x.astype(self.dtype))
         act = nn.gelu(jnp.einsum("ebch,ehf->ebcf", xin,
                                  wi.astype(self.dtype)))
         out = jnp.einsum("ebcf,efh->ebch", act, wo.astype(self.dtype))
-        y = jnp.einsum("bsec,ebch->bsh", combine.astype(self.dtype), out)
+        y = jnp.einsum("bsec,ebch->bsh", combine, out)
         return y.astype(x.dtype)

@@ -41,14 +41,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_hc_bench.topology import DATA_AXIS, PIPE_AXIS
 
 
-def pipeline_apply(block_fn, stage_params, x_mb, axis_name: str = PIPE_AXIS):
+def pipeline_apply(block_fn, stage_params, x_mb, axis_name: str = PIPE_AXIS,
+                   rng=None):
     """Run microbatches through the pipeline; must be inside shard_map.
 
-    ``block_fn(layer_params, h) -> h`` applies ONE layer.  ``stage_params``
-    is this stage's ``[L_local, ...]`` stacked layer pytree.  ``x_mb`` is
-    ``[M, mb, ...]`` microbatched activations, replicated over the pipe
-    axis (only stage 0 reads them).  Returns ``[M, mb, ...]`` pipeline
-    outputs, identical on every stage (psum-broadcast from the last).
+    ``block_fn(layer_params, h, key) -> (h, aux)`` applies ONE layer
+    (``key`` is a per-(stage, layer, tick) dropout key, or None when
+    ``rng`` is None; ``aux`` is a scalar auxiliary-loss term, 0 for plain
+    layers).  ``stage_params`` is this stage's ``[L_local, ...]`` stacked
+    layer pytree.  ``x_mb`` is ``[M, mb, ...]`` microbatched activations,
+    replicated over the pipe axis (only stage 0 reads them).  Returns
+    ``([M, mb, ...] outputs, aux_sum)``: outputs identical on every stage
+    (psum-broadcast from the last); ``aux_sum`` is this *stage's* summed
+    aux over its layers and the M valid microbatches (bubble ticks that
+    process garbage activations are excluded by the validity gate).
 
     The scan runs ``M + n - 1`` ticks (GPipe fill + drain); at tick t,
     stage 0 injects microbatch t, stage ``s`` works on microbatch
@@ -57,24 +63,39 @@ def pipeline_apply(block_fn, stage_params, x_mb, axis_name: str = PIPE_AXIS):
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     num_mb = x_mb.shape[0]
+    n_local = jax.tree.leaves(stage_params)[0].shape[0]
 
-    def stage_apply(h):
-        def body(h, p):
-            return block_fn(p, h), None
+    def stage_apply(h, t):
+        if rng is None:
+            keys = jnp.zeros((n_local, 2), jnp.uint32)  # unused placeholder
+        else:
+            # unique per (stage, tick, layer)
+            keys = jax.random.split(
+                jax.random.fold_in(jax.random.fold_in(rng, t), idx), n_local)
 
-        h, _ = jax.lax.scan(body, h, stage_params)
-        return h
+        def body(h, xs):
+            p, key = xs
+            h, aux = block_fn(p, h, None if rng is None else key)
+            return h, aux
+
+        h, auxes = jax.lax.scan(body, h, (stage_params, keys))
+        return h, auxes.sum()
 
     perm = [(i, (i + 1) % n) for i in range(n)]
     state0 = jnp.zeros_like(x_mb[0])
     out0 = jnp.zeros_like(x_mb)
+    aux0 = jnp.zeros((), jnp.float32)
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         mb_in = jax.lax.dynamic_index_in_dim(
             x_mb, jnp.clip(t, 0, num_mb - 1), 0, keepdims=False)
         h = jnp.where(idx == 0, mb_in, state)
-        y = stage_apply(h)
+        y, aux = stage_apply(h, t)
+        # this stage works on microbatch t - idx; outside [0, M) it is a
+        # fill/drain bubble processing garbage -> drop its aux term
+        valid = (t - idx >= 0) & (t - idx < num_mb)
+        aux_acc = aux_acc + jnp.where(valid, aux.astype(jnp.float32), 0.0)
         t_out = t - (n - 1)
         o_idx = jnp.clip(t_out, 0, num_mb - 1)
         cur = jax.lax.dynamic_index_in_dim(outputs, o_idx, 0, keepdims=False)
@@ -82,14 +103,14 @@ def pipeline_apply(block_fn, stage_params, x_mb, axis_name: str = PIPE_AXIS):
         outputs = jax.lax.dynamic_update_index_in_dim(outputs, retired,
                                                       o_idx, 0)
         state = jax.lax.ppermute(y, axis_name, perm)
-        return (state, outputs), None
+        return (state, outputs, aux_acc), None
 
-    (_, outputs), _ = jax.lax.scan(
-        tick, (state0, out0), jnp.arange(num_mb + n - 1))
+    (_, outputs, aux_sum), _ = jax.lax.scan(
+        tick, (state0, out0, aux0), jnp.arange(num_mb + n - 1))
     # broadcast the retired outputs from the last stage to every stage
     outputs = jax.lax.psum(
         jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)), axis_name)
-    return outputs
+    return outputs, aux_sum
 
 
 def stack_layer_params(params: dict, num_layers: int) -> dict:
@@ -134,16 +155,21 @@ def _opt_specs(opt_state, param_specs: dict, params: dict):
 
 
 def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
-                        example_params: dict, example_opt_state):
+                        example_params: dict, example_opt_state,
+                        deterministic: bool = False):
     """DP x PP training step for the GPT decoder family.
 
     ``model`` is a ``GPTLM`` whose params have been restacked with
     ``stack_layer_params``.  The step is a ``shard_map`` over the
     ``(data, pipe)`` mesh: batch sharded over data, trunk sharded over
-    pipe, embed/head replicated.  Forward matches ``GPTLM.__call__`` with
-    ``train=False`` exactly (embed + pos, pipelined pre-LN decoder layers,
-    final LN, tied f32 output projection); MoE aux losses are not
-    collected on this path (immutable apply drops the sow).
+    pipe, embed/head replicated.  Forward matches ``GPTLM.__call__``
+    (embed + pos + dropout, pipelined pre-LN decoder layers, final LN,
+    tied f32 output projection); ``deterministic=True`` disables dropout
+    (the numerically-testable mode, = ``train=False``).  MoE layers'
+    Switch aux losses ARE collected: each stage sums its layers' sown
+    terms over the valid microbatches (``pipeline_apply``), and the
+    per-microbatch mean joins the objective at ``AUX_LOSS_COEF`` exactly
+    like the non-PP step.
     """
     from flax import linen as nn
 
@@ -157,34 +183,50 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
     ln_f = nn.LayerNorm(dtype=model.dtype)
     tx = make_optimizer(cfg)
 
-    def block_fn(p, h):
-        return layer.apply({"params": p}, h, False)
+    def block_fn(p, h, key):
+        rngs = None if key is None else {"dropout": key}
+        y, upd = layer.apply({"params": p}, h, not deterministic and
+                             key is not None, rngs=rngs, mutable=["losses"])
+        terms = jax.tree.leaves(upd.get("losses", {}))
+        aux = (sum(jnp.sum(t) for t in terms) if terms
+               else jnp.zeros((), jnp.float32))
+        return y, aux
 
     if model.remat:
         # --gradient_checkpointing: recompute each layer in the backward
         block_fn = jax.checkpoint(block_fn)
 
-    def forward(params, tokens):
+    def forward(params, tokens, rng):
         wte = params["wte"]["embedding"]
         wpe = params["wpe"]["embedding"]
         b, s = tokens.shape
         x = (wte.astype(model.dtype)[tokens]
              + wpe.astype(model.dtype)[jnp.arange(s)][None])
+        if rng is not None:
+            # GPTLM's post-embedding Dropout(0.1)
+            rng, ekey = jax.random.split(rng)
+            keep = jax.random.bernoulli(ekey, 0.9, x.shape)
+            x = jnp.where(keep, x / 0.9, jnp.zeros_like(x))
         mb = b // num_microbatches
         xs = x.reshape(num_microbatches, mb, s, model.hidden)
-        ys = pipeline_apply(block_fn, params["trunk"], xs)
+        ys, aux = pipeline_apply(block_fn, params["trunk"], xs, rng=rng)
         x = ys.reshape(b, s, model.hidden)
         x = ln_f.apply({"params": params["ln_f"]}, x)
-        return jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
-                          wte.astype(jnp.float32))
+        logits = jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
+                            wte.astype(jnp.float32))
+        return logits, aux
 
-    def device_step(params, opt_state, batch):
+    def device_step(params, opt_state, batch, rng):
         tokens, targets, weights = batch
         n_pipe = jax.lax.axis_size(PIPE_AXIS)
         is_last = jax.lax.axis_index(PIPE_AXIS) == n_pipe - 1
+        if deterministic:
+            rng = None
+        else:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
 
         def loss_fn(p):
-            logits = forward(p, tokens)
+            logits, aux = forward(p, tokens, rng)
             losses = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets)
             loss = (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0)
@@ -192,8 +234,14 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
             # output, but only the LAST stage's loss is "real": gating it
             # makes exactly one backward seed enter the shared pipeline per
             # data column, so no cotangent is double-counted regardless of
-            # psum-transpose semantics
-            return jnp.where(is_last, loss, 0.0)
+            # psum-transpose semantics.  The aux term is NOT gated: each
+            # stage's sum is a distinct term of the objective, seeded once
+            # on its own rank (the per-microbatch mean matches the non-PP
+            # step's batch-mean aux because routing groups are batch rows).
+            from tpu_hc_bench.models.moe import AUX_LOSS_COEF
+
+            return (jnp.where(is_last, loss, 0.0)
+                    + AUX_LOSS_COEF * aux / num_microbatches)
 
         if cfg.forward_only:
             loss = loss_fn(params)
@@ -223,14 +271,16 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
     ospecs = _opt_specs(example_opt_state, pspecs, example_params)
     shard_fn = jax.shard_map(
         device_step, mesh=mesh,
-        in_specs=(pspecs, ospecs, P(DATA_AXIS)),
+        in_specs=(pspecs, ospecs, P(DATA_AXIS), P()),
         out_specs=(pspecs, ospecs, P()),
         check_vma=False,
     )
     jitted = jax.jit(shard_fn, donate_argnums=(0, 1))
 
-    def step(params, opt_state, batch):
-        return jitted(params, opt_state, batch)
+    def step(params, opt_state, batch, rng=None):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # unused in deterministic mode
+        return jitted(params, opt_state, batch, rng)
 
     return step, tx
 

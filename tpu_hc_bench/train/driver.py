@@ -420,8 +420,7 @@ def run_benchmark(
         state = (params, opt_state)
 
         def train_step(state, batch, rng):
-            del rng  # PP forward runs layers deterministic (no dropout)
-            new_params, new_opt, loss = pp_step(*state, batch)
+            new_params, new_opt, loss = pp_step(*state, batch, rng)
             return (new_params, new_opt), {"loss": loss}
 
         batch_iter = batches()
