@@ -117,6 +117,56 @@ def test_ep_matches_replicated(devices):
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
 
 
+def test_ragged_matches_einsum_no_drops():
+    """With capacity sized so nothing drops, the ragged (grouped-matmul)
+    impl must equal the GShard einsum impl exactly (same routing, same
+    gates; only the data movement differs)."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 32))
+    # capacity_factor = e/top_k makes capacity == s: overflow impossible
+    kw = dict(hidden=32, ffn=64, num_experts=4, top_k=2,
+              capacity_factor=2.0)
+    einsum_layer = MoEFFN(**kw, impl="einsum")
+    ragged_layer = MoEFFN(**kw, impl="ragged")
+    params = einsum_layer.init(jax.random.PRNGKey(2), x)["params"]
+
+    def run(layer):
+        y, upd = layer.apply({"params": params}, x, mutable=["losses"])
+        aux = sum(jnp.sum(t) for t in jax.tree.leaves(upd["losses"]))
+        return y, aux
+
+    y_e, aux_e = run(einsum_layer)
+    y_r, aux_r = run(ragged_layer)
+    np.testing.assert_allclose(np.asarray(y_e), np.asarray(y_r),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux_e), float(aux_r), rtol=1e-6)
+
+
+def test_ragged_backward_and_no_drops():
+    """Ragged impl: gradients flow to router and experts; capacity-free
+    dispatch keeps every token (combine weights sum to 1)."""
+    layer = MoEFFN(hidden=16, ffn=32, num_experts=4, top_k=2, impl="ragged")
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 24, 16))
+    params = layer.init(jax.random.PRNGKey(4), x)["params"]
+
+    def loss_fn(p):
+        y, _ = layer.apply({"params": p}, x, mutable=["losses"])
+        return jnp.sum(y ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    for name in ("router", "wi", "wo"):
+        leaf = grads[name]["kernel"] if name == "router" else grads[name]
+        assert float(jnp.abs(leaf).max()) > 0.0
+
+
+def test_moe_impl_flag_guards():
+    with pytest.raises(ValueError, match="moe_impl=einsum"):
+        flags.BenchmarkConfig(expert_parallel=2, moe_impl="ragged").resolve()
+    from tpu_hc_bench.models import create_model
+    with pytest.raises(ValueError, match="MoE members"):
+        create_model("gpt2", moe_impl="ragged")
+
+
 def test_ep_exclusive_with_tp():
     with pytest.raises(ValueError, match="exclusive"):
         flags.BenchmarkConfig(model_parallel=2, expert_parallel=2).resolve()

@@ -149,6 +149,10 @@ class BenchmarkConfig:
     attention_impl: str = "dense"             # dense|flash: transformer
                                               # attention kernel (flash =
                                               # Pallas blocked softmax)
+    moe_impl: str = "einsum"                  # einsum|ragged: MoE dispatch
+                                              # (einsum = GShard GSPMD/EP;
+                                              # ragged = grouped-matmul
+                                              # ragged_dot fast DP path)
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -198,12 +202,22 @@ class BenchmarkConfig:
                 "--pipeline_parallel cannot be combined with "
                 "--model_parallel/--expert_parallel on the 2-D mesh"
             )
+        if self.expert_parallel > 1 and self.moe_impl == "ragged":
+            raise ValueError(
+                "--expert_parallel requires --moe_impl=einsum (ragged_dot "
+                "grouped matmuls are single-shard; the GShard einsum "
+                "dispatch is the GSPMD-shardable path)"
+            )
         if self.pipeline_parallel > 1:
-            t["variable_update"] = (
+            note = (
                 f"{self.variable_update}->n/a (pipeline_parallel="
                 f"{self.pipeline_parallel} runs the dedicated GPipe "
                 f"shard_map step with its own gradient psums)"
             )
+            # append rather than overwrite: an earlier horovod->psum
+            # record must stay in the audit trail
+            prior = t.get("variable_update")
+            t["variable_update"] = f"{prior}; {note}" if prior else note
         sharded = max(self.model_parallel, self.expert_parallel)
         if sharded > 1 and self.variable_update != "replicated":
             which = ("model_parallel" if self.model_parallel > 1
@@ -297,6 +311,8 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.gradient_checkpointing)
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"])
+    p.add_argument("--moe_impl", type=str, default=d.moe_impl,
+                   choices=["einsum", "ragged"])
     return p
 
 

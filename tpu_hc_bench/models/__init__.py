@@ -45,6 +45,7 @@ class ModelSpec:
     supports_s2d: bool = False         # stem accepts space_to_depth=True
     vocab_size: int = 30522            # text models: synthetic-data label space
     causal_lm: bool = False            # text models: next-token objective
+    moe: bool = False                  # factory accepts moe_impl
 
 
 def _registry() -> dict[str, ModelSpec]:
@@ -123,9 +124,10 @@ def _registry() -> dict[str, ModelSpec]:
         # sparse MoE decoder: FLOPs figure counts *active* params per token
         # (top-2 of 8 experts ~= 2x FFN of the dense 124M trunk)
         ModelSpec("gpt2_moe", gpt.gpt2_moe, (1024,), 2 * 180e6 * 1024,
-                  is_text=True, vocab_size=gpt.GPT2_VOCAB, causal_lm=True),
+                  is_text=True, vocab_size=gpt.GPT2_VOCAB, causal_lm=True,
+                  moe=True),
         ModelSpec("moe_tiny", gpt.moe_tiny, (64,), 2 * 3e6 * 64,
-                  is_text=True, vocab_size=1024, causal_lm=True),
+                  is_text=True, vocab_size=1024, causal_lm=True, moe=True),
     ]
     return {s.name: s for s in specs}
 
@@ -163,9 +165,14 @@ def list_models() -> list[str]:
 def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                  attention_impl: str = "dense", space_to_depth: bool = False,
                  seq_len: int | None = None,
-                 gradient_checkpointing: bool = False):
+                 gradient_checkpointing: bool = False,
+                 moe_impl: str = "einsum"):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
+    if spec.moe:
+        kwargs["moe_impl"] = moe_impl
+    elif moe_impl != "einsum":
+        raise ValueError(f"--moe_impl only applies to MoE members, not {name}")
     if spec.is_text:   # attention kernel choice only exists for transformers
         kwargs["attention_impl"] = attention_impl
         kwargs["remat"] = gradient_checkpointing

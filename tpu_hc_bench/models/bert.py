@@ -149,11 +149,19 @@ class BertMLM(nn.Module):
                 attention_impl=self.attention_impl, seq_axis=self.seq_axis,
                 name=f"layer_{i}",
             )(x, None, train)
-        # MLM head: dense+gelu+LN, then tied-embedding projection
+        # MLM head: dense+gelu+LN, then tied-embedding projection.  The
+        # [hidden, vocab] matmul runs with operands in the compute dtype
+        # and f32 accumulation (preferred_element_type) — the MXU's native
+        # mode; a true-f32 matmul here is emulated in multiple bf16 passes
+        # and dominates the head cost at 30k vocab.
         x = nn.Dense(self.hidden, dtype=self.dtype, name="mlm_dense")(x)
         x = nn.gelu(x)
         x = nn.LayerNorm(dtype=self.dtype, name="mlm_ln")(x)
-        logits = embed.attend(x.astype(jnp.float32))
+        logits = jnp.einsum(
+            "bsh,vh->bsv", x.astype(self.dtype),
+            embed.embedding.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        )
         bias = self.param("mlm_bias", nn.initializers.zeros, (self.vocab_size,))
         return logits + bias
 

@@ -42,6 +42,7 @@ class DecoderLayer(nn.Module):
     seq_axis: str | None = None
     num_experts: int = 0
     top_k: int = 2
+    moe_impl: str = "einsum"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -57,7 +58,8 @@ class DecoderLayer(nn.Module):
             from tpu_hc_bench.models.moe import MoEFFN
 
             h = MoEFFN(self.hidden, self.ffn, self.num_experts,
-                       top_k=self.top_k, dtype=self.dtype, name="moe")(h)
+                       top_k=self.top_k, dtype=self.dtype,
+                       impl=self.moe_impl, name="moe")(h)
         else:
             h = nn.Dense(self.ffn, dtype=self.dtype, name="fc")(h)
             h = nn.gelu(h)
@@ -78,6 +80,7 @@ class GPTLM(nn.Module):
     remat: bool = False                # recompute layers in backward
     num_experts: int = 0               # >0: MoE FFNs (models/moe.py)
     top_k: int = 2
+    moe_impl: str = "einsum"           # einsum (GSPMD/EP) | ragged (fast DP)
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True):
@@ -98,15 +101,16 @@ class GPTLM(nn.Module):
                 self.hidden, self.heads, self.ffn, dtype=self.dtype,
                 attention_impl=self.attention_impl, seq_axis=self.seq_axis,
                 num_experts=self.num_experts, top_k=self.top_k,
-                name=f"layer_{i}",
+                moe_impl=self.moe_impl, name=f"layer_{i}",
             )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        # tied output projection in explicit float32 (embed.attend would
-        # cast operands back to the Embed compute dtype, yielding bf16
-        # logits; the 50k-vocab cross-entropy wants f32)
+        # tied output projection: operands in compute dtype, f32
+        # accumulation (the MXU-native mode; the 50k-vocab cross-entropy
+        # still sees f32 logits, but a true-f32 matmul would be emulated)
         return jnp.einsum(
-            "bsh,vh->bsv", x.astype(jnp.float32),
-            embed.embedding.astype(jnp.float32),
+            "bsh,vh->bsv", x.astype(self.dtype),
+            embed.embedding.astype(self.dtype),
+            preferred_element_type=jnp.float32,
         )
 
 
@@ -131,21 +135,22 @@ def gpt2_medium(num_classes: int = 0, dtype=jnp.float32,
 
 def gpt2_moe(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
-             remat: bool = False):
+             remat: bool = False, moe_impl: str = "einsum"):
     """GPT-2-small trunk with 8-expert top-2 MoE FFNs (~520M params,
-    ~124M active per token) — the expert-parallel workload."""
+    ~180M active per token: the 124M dense trunk swaps its 57M of FFNs
+    for 2x-of-8 expert FFNs) — the expert-parallel workload."""
     del num_classes
     return GPTLM(dtype=dtype, attention_impl=attention_impl,
                  max_len=max(GPT2_CTX, max_len or 0), remat=remat,
-                 num_experts=8, top_k=2)
+                 num_experts=8, top_k=2, moe_impl=moe_impl)
 
 
 def moe_tiny(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
-             remat: bool = False):
+             remat: bool = False, moe_impl: str = "einsum"):
     """4-layer/128-hidden 4-expert decoder for tests and CPU smoke runs."""
     del num_classes
     return GPTLM(vocab_size=1024, hidden=128, num_layers=4, heads=4,
                  ffn=256, dtype=dtype, attention_impl=attention_impl,
                  max_len=max(128, max_len or 0), remat=remat,
-                 num_experts=4, top_k=2)
+                 num_experts=4, top_k=2, moe_impl=moe_impl)

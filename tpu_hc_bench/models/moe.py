@@ -36,6 +36,35 @@ import jax.numpy as jnp
 AUX_LOSS_COEF = 0.01
 
 
+def topk_select(probs: jax.Array, top_k: int):
+    """Shared top-k routing selection over the trailing expert axis.
+
+    ``probs``: [..., E] router softmax.  Returns ``(masks, gates,
+    choices, aux)``: per-k one-hot masks [..., E], per-k gate weights
+    [...] normalized to sum to 1 per token, per-k argmax indices [...],
+    and the Switch load-balance aux (E * Σ_e f_e · p̄_e from the k=0
+    assignment, token means over all leading axes).  Both dispatch impls
+    (einsum capacity routing, ragged grouped matmuls) derive from this
+    one selection so they cannot diverge.
+    """
+    e = probs.shape[-1]
+    masks, gates, choices = [], [], []
+    p = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)
+        mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)
+        choices.append(idx)
+        gates.append((p * mask).sum(-1))
+        masks.append(mask)
+        p = p * (1.0 - mask)
+    token_axes = tuple(range(probs.ndim - 1))
+    aux = e * jnp.sum(masks[0].mean(token_axes) * probs.mean(token_axes))
+    # normalize the selected gates to sum to 1 per token (top-2 convention)
+    denom = jnp.maximum(sum(gates), 1e-9)
+    gates = [g / denom for g in gates]
+    return masks, gates, choices, aux
+
+
 def top_k_routing(probs: jax.Array, top_k: int, capacity: int):
     """Build dispatch/combine tensors from router probabilities.
 
@@ -47,24 +76,7 @@ def top_k_routing(probs: jax.Array, top_k: int, capacity: int):
     position-in-expert cumsum).
     """
     b, s, e = probs.shape
-    masks, gates = [], []
-    p = probs
-    for _ in range(top_k):
-        idx = jnp.argmax(p, axis=-1)                    # [B, S]
-        mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)  # [B, S, E]
-        gates.append((p * mask).sum(-1))                # [B, S]
-        masks.append(mask)
-        p = p * (1.0 - mask)
-
-    # Switch aux loss from the k=0 assignment (pre-capacity): fraction of
-    # tokens routed to each expert x mean router prob, summed, scaled by E.
-    frac = masks[0].mean(axis=(0, 1))                   # [E]
-    mean_prob = probs.mean(axis=(0, 1))                 # [E]
-    aux_loss = e * jnp.sum(frac * mean_prob)
-
-    # normalize the selected gates to sum to 1 per token (top-2 convention)
-    denom = jnp.maximum(sum(gates), 1e-9)
-    gates = [g / denom for g in gates]
+    masks, gates, _, aux_loss = topk_select(probs, top_k)
 
     dispatch = jnp.zeros((b, s, e, capacity), probs.dtype)
     combine = jnp.zeros((b, s, e, capacity), probs.dtype)
@@ -86,8 +98,18 @@ class MoEFFN(nn.Module):
     """Sparse MoE feed-forward block: drop-in for a transformer's dense FFN.
 
     Expert-major params (``wi [E, H, F]``, ``wo [E, F, H]``) so expert
-    parallelism is a single leading-dim PartitionSpec.  All dispatch math
-    is einsum; activations follow ``dtype`` (bf16-safe), router in f32.
+    parallelism is a single leading-dim PartitionSpec.  Router in f32,
+    activations follow ``dtype`` (bf16-safe).  Two dispatch impls:
+
+    - ``impl="einsum"`` (default): GShard dense dispatch/combine tensors.
+      Fully GSPMD-shardable — the expert-parallel path — but pays the
+      O(B·S·E·C) dispatch einsums and drops capacity-overflow tokens.
+    - ``impl="ragged"``: sort token-expert pairs by expert and run the
+      experts as grouped matmuls (``jax.lax.ragged_dot``, the TPU's
+      native MoE primitive).  No capacity concept (zero token drops), no
+      dispatch matmuls, no padding waste; single-shard expert compute, so
+      it is the fast path for DP runs (``--expert_parallel`` requires
+      einsum).
     """
 
     hidden: int
@@ -96,21 +118,36 @@ class MoEFFN(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     dtype: Any = jnp.float32
+    impl: str = "einsum"
 
     @nn.compact
     def __call__(self, x):
         b, s, h = x.shape
         e = self.num_experts
-        # per-group (= per batch row) expert capacity, floor of 4 slots
-        import math
-
-        capacity = max(4, math.ceil(self.capacity_factor * self.top_k * s / e))
 
         router = nn.Dense(e, use_bias=False, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="router")
         probs = jax.nn.softmax(router(x.astype(jnp.float32)), axis=-1)
-        dispatch, combine, aux = top_k_routing(probs, self.top_k, capacity)
+
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        wi = self.param("wi", init, (e, h, self.ffn))
+        wo = self.param("wo", init, (e, self.ffn, h))
+
+        if self.impl == "ragged":
+            y, aux = self._ragged(x, probs, wi, wo)
+        elif self.impl == "einsum":
+            y, aux = self._einsum(x, probs, wi, wo, s, e)
+        else:
+            raise ValueError(f"unknown moe impl {self.impl!r}")
         self.sow("losses", "moe_aux", aux)
+        return y.astype(x.dtype)
+
+    def _einsum(self, x, probs, wi, wo, s, e):
+        # per-group (= per batch row) expert capacity, floor of 4 slots
+        import math
+
+        capacity = max(4, math.ceil(self.capacity_factor * self.top_k * s / e))
+        dispatch, combine, aux = top_k_routing(probs, self.top_k, capacity)
         # the [B,S,E,C] dispatch/combine tensors dominate the layer's
         # activation memory (they are saved for backward); store them in
         # the compute dtype — dispatch is 0/1 exactly, combine gates lose
@@ -118,13 +155,35 @@ class MoEFFN(nn.Module):
         dispatch = dispatch.astype(self.dtype)
         combine = combine.astype(self.dtype)
 
-        init = nn.initializers.lecun_normal(batch_axis=(0,))
-        wi = self.param("wi", init, (e, h, self.ffn))
-        wo = self.param("wo", init, (e, self.ffn, h))
-
         xin = jnp.einsum("bsec,bsh->ebch", dispatch, x.astype(self.dtype))
         act = nn.gelu(jnp.einsum("ebch,ehf->ebcf", xin,
                                  wi.astype(self.dtype)))
         out = jnp.einsum("ebcf,efh->ebch", act, wo.astype(self.dtype))
         y = jnp.einsum("bsec,ebch->bsh", combine, out)
-        return y.astype(x.dtype)
+        return y, aux
+
+    def _ragged(self, x, probs, wi, wo):
+        b, s, h = x.shape
+        e, k = self.num_experts, self.top_k
+        n = b * s
+        flat = x.reshape(n, h).astype(self.dtype)
+        p = probs.reshape(n, e)
+        _, gate_list, choices, aux = topk_select(p, k)
+        gates = jnp.stack(gate_list, 1)                   # [N, k]
+
+        # token-major (token, choice) pairs sorted by expert -> grouped
+        # matmuls over contiguous expert segments
+        pair_expert = jnp.stack(choices, 1).reshape(n * k)
+        pair_token = jnp.repeat(jnp.arange(n), k)
+        order = jnp.argsort(pair_expert)
+        group_sizes = jnp.bincount(pair_expert, length=e).astype(jnp.int32)
+        xs = flat[pair_token[order]]                      # [N*k, H]
+        h1 = nn.gelu(jax.lax.ragged_dot(xs, wi.astype(self.dtype),
+                                        group_sizes))
+        out = jax.lax.ragged_dot(h1, wo.astype(self.dtype), group_sizes)
+        # inverse-permute back to token-major pair order; weighted sum
+        # over each token's k picks (pure gathers, no scatter)
+        inv = jnp.argsort(order)
+        out = out[inv].reshape(n, k, h)
+        y = (out * gates[..., None].astype(self.dtype)).sum(axis=1)
+        return y.reshape(b, s, h), aux

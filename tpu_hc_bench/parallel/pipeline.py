@@ -168,8 +168,9 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
     (the numerically-testable mode, = ``train=False``).  MoE layers'
     Switch aux losses ARE collected: each stage sums its layers' sown
     terms over the valid microbatches (``pipeline_apply``), and the
-    per-microbatch mean joins the objective at ``AUX_LOSS_COEF`` exactly
-    like the non-PP step.
+    per-microbatch-grouped mean joins the objective at ``AUX_LOSS_COEF``
+    (a grouped estimator of the same Switch statistic — not bitwise the
+    full-batch value; see the note in ``device_step``).
     """
     from flax import linen as nn
 
@@ -178,7 +179,7 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
 
     layer = DecoderLayer(model.hidden, model.heads, model.ffn,
                          dtype=model.dtype, num_experts=model.num_experts,
-                         top_k=model.top_k,
+                         top_k=model.top_k, moe_impl=model.moe_impl,
                          attention_impl=model.attention_impl)
     ln_f = nn.LayerNorm(dtype=model.dtype)
     tx = make_optimizer(cfg)
@@ -203,17 +204,20 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
         x = (wte.astype(model.dtype)[tokens]
              + wpe.astype(model.dtype)[jnp.arange(s)][None])
         if rng is not None:
-            # GPTLM's post-embedding Dropout(0.1)
+            # GPTLM's post-embedding Dropout (stateless module apply keeps
+            # the rate defined in one place)
             rng, ekey = jax.random.split(rng)
-            keep = jax.random.bernoulli(ekey, 0.9, x.shape)
-            x = jnp.where(keep, x / 0.9, jnp.zeros_like(x))
+            x = nn.Dropout(0.1, deterministic=False).apply(
+                {}, x, rngs={"dropout": ekey})
         mb = b // num_microbatches
         xs = x.reshape(num_microbatches, mb, s, model.hidden)
         ys, aux = pipeline_apply(block_fn, params["trunk"], xs, rng=rng)
         x = ys.reshape(b, s, model.hidden)
         x = ln_f.apply({"params": params["ln_f"]}, x)
-        logits = jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
-                            wte.astype(jnp.float32))
+        # compute-dtype operands + f32 accumulation, matching GPTLM's head
+        logits = jnp.einsum("bsh,vh->bsv", x.astype(model.dtype),
+                            wte.astype(model.dtype),
+                            preferred_element_type=jnp.float32)
         return logits, aux
 
     def device_step(params, opt_state, batch, rng):
@@ -236,8 +240,11 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
             # data column, so no cotangent is double-counted regardless of
             # psum-transpose semantics.  The aux term is NOT gated: each
             # stage's sum is a distinct term of the objective, seeded once
-            # on its own rank (the per-microbatch mean matches the non-PP
-            # step's batch-mean aux because routing groups are batch rows).
+            # on its own rank.  NOTE the per-microbatch aux mean is a
+            # *grouped estimator*: the Switch aux is a product of two
+            # per-group means, so it differs from the full-batch statistic
+            # by the cross-group covariance (same estimator family the
+            # data-sharded non-PP step uses per device shard).
             from tpu_hc_bench.models.moe import AUX_LOSS_COEF
 
             return (jnp.where(is_last, loss, 0.0)
@@ -279,7 +286,10 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
 
     def step(params, opt_state, batch, rng=None):
         if rng is None:
-            rng = jax.random.PRNGKey(0)  # unused in deterministic mode
+            # fixed-key fallback: fine for deterministic mode (ignored) and
+            # one-off dryruns; per-step training should pass a fresh key
+            # (the driver folds its step counter in)
+            rng = jax.random.PRNGKey(0)
         return jitted(params, opt_state, batch, rng)
 
     return step, tx

@@ -318,7 +318,8 @@ def run_benchmark(
                                dtype=dtype, attention_impl=cfg.attention_impl,
                                space_to_depth=cfg.use_space_to_depth,
                                seq_len=cfg.seq_len,
-                               gradient_checkpointing=cfg.gradient_checkpointing)
+                               gradient_checkpointing=cfg.gradient_checkpointing,
+                               moe_impl=getattr(cfg, "moe_impl", "einsum"))
 
     # --- banner (reference :52-58 config echo) ---
     for line in layout.summary_lines(fabric=fab.value):
@@ -444,10 +445,12 @@ def run_benchmark(
     rng = jax.random.PRNGKey(cfg.seed + 17)
 
     # --- warmup (includes compile; reference warmup=50, :32) ---
+    # rng is folded with the step counter so dropout masks differ per step
     t_compile = time.perf_counter()
     metrics = None
-    for _ in range(max(1, cfg.num_warmup_batches)):
-        state, metrics = train_step(state, next(batch_iter), rng)
+    for w in range(max(1, cfg.num_warmup_batches)):
+        state, metrics = train_step(state, next(batch_iter),
+                                    jax.random.fold_in(rng, w))
     drain(metrics["loss"])
     print_fn(
         f"warmup done: {cfg.num_warmup_batches} steps in "
@@ -472,8 +475,10 @@ def run_benchmark(
     timeline = _AsyncTimeline(cfg.num_batches, cfg.display_every,
                               global_batch)
     timeline.start(metrics["loss"])
+    warmup_steps = max(1, cfg.num_warmup_batches)
     for i in range(1, cfg.num_batches + 1):
-        state, metrics = train_step(state, next(batch_iter), rng)
+        state, metrics = train_step(state, next(batch_iter),
+                                    jax.random.fold_in(rng, warmup_steps + i))
         timeline.record(i, metrics["loss"])
         if tracing and timeline.fetcher.fetched_step >= timeline.sync_every:
             jax.profiler.stop_trace()
