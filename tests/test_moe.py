@@ -162,6 +162,9 @@ def test_ragged_backward_and_no_drops():
 def test_moe_impl_flag_guards():
     with pytest.raises(ValueError, match="moe_impl=einsum"):
         flags.BenchmarkConfig(expert_parallel=2, moe_impl="ragged").resolve()
+    # TP also shards the expert tensors (tp_param_spec moe/ rules)
+    with pytest.raises(ValueError, match="moe_impl=einsum"):
+        flags.BenchmarkConfig(model_parallel=2, moe_impl="ragged").resolve()
     from tpu_hc_bench.models import create_model
     with pytest.raises(ValueError, match="MoE members"):
         create_model("gpt2", moe_impl="ragged")

@@ -202,11 +202,15 @@ class BenchmarkConfig:
                 "--pipeline_parallel cannot be combined with "
                 "--model_parallel/--expert_parallel on the 2-D mesh"
             )
-        if self.expert_parallel > 1 and self.moe_impl == "ragged":
+        if self.moe_impl == "ragged" and (
+                self.expert_parallel > 1 or self.model_parallel > 1):
+            # TP also shards the expert tensors over the model axis
+            # (tp_param_spec's moe/ rules), so both spellings are blocked
             raise ValueError(
-                "--expert_parallel requires --moe_impl=einsum (ragged_dot "
-                "grouped matmuls are single-shard; the GShard einsum "
-                "dispatch is the GSPMD-shardable path)"
+                "--expert_parallel/--model_parallel require "
+                "--moe_impl=einsum (ragged_dot grouped matmuls are "
+                "single-shard; the GShard einsum dispatch is the "
+                "GSPMD-shardable path)"
             )
         if self.pipeline_parallel > 1:
             note = (

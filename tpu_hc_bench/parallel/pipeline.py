@@ -129,6 +129,48 @@ def unstack_layer_params(params: dict, num_layers: int) -> dict:
     return out
 
 
+def _map_param_like(opt_state, params, f, otherwise=None):
+    """Apply ``f`` to every params-shaped subtree of an optax state
+    (momentum/adam moments); other leaves pass through ``otherwise``
+    (default: untouched).  The single home of the is-param-like
+    structural predicate — sharding-spec derivation and checkpoint
+    restacking must agree on it."""
+    pstruct = jax.tree.structure(params)
+    is_param_like = lambda n: jax.tree.structure(n) == pstruct
+
+    def per_node(n):
+        if is_param_like(n):
+            return f(n)
+        return n if otherwise is None else otherwise(n)
+
+    return jax.tree.map(per_node, opt_state, is_leaf=is_param_like)
+
+
+def pp_state_from_train_state(state, num_layers: int):
+    """DP TrainState -> PP ``(params, opt_state)`` (checkpoint interchange).
+
+    Restacks the ``layer_i`` param subtrees — and the params-shaped
+    subtrees of the optimizer state (momentum trace) — into the
+    pipe-shardable ``trunk`` layout, so a run checkpointed under DP
+    resumes under DP x PP with the optimizer state intact.  The reverse
+    direction is ``train_state_from_pp``.
+    """
+    params = stack_layer_params(state.params, num_layers)
+    opt_state = _map_param_like(
+        state.opt_state, state.params,
+        lambda t: stack_layer_params(t, num_layers))
+    return params, opt_state
+
+
+def train_state_from_pp(params: dict, opt_state, template, num_layers: int):
+    """PP ``(params, opt_state)`` -> DP TrainState (via a template state
+    supplying apply_fn/tx/step/batch_stats)."""
+    p = unstack_layer_params(params, num_layers)
+    opt = _map_param_like(opt_state, params,
+                          lambda t: unstack_layer_params(t, num_layers))
+    return template.replace(params=p, opt_state=opt)
+
+
 def pp_param_specs(params: dict) -> dict:
     """trunk shards its leading (layer) dim over pipe; the rest replicates."""
     return {
@@ -142,16 +184,8 @@ def pp_param_specs(params: dict) -> dict:
 def _opt_specs(opt_state, param_specs: dict, params: dict):
     """Specs for the optimizer state: param-shaped subtrees (momentum
     trace) inherit the param specs, everything else replicates."""
-    pstruct = jax.tree.structure(params)
-
-    def per_node(node):
-        if jax.tree.structure(node) == pstruct:
-            return param_specs
-        return jax.tree.map(lambda _: P(), node)
-
-    return jax.tree.map(
-        per_node, opt_state,
-        is_leaf=lambda n: jax.tree.structure(n) == pstruct)
+    return _map_param_like(opt_state, params, lambda _: param_specs,
+                           otherwise=lambda _: P())
 
 
 def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
@@ -204,8 +238,8 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
         x = (wte.astype(model.dtype)[tokens]
              + wpe.astype(model.dtype)[jnp.arange(s)][None])
         if rng is not None:
-            # GPTLM's post-embedding Dropout (stateless module apply keeps
-            # the rate defined in one place)
+            # GPTLM's post-embedding dropout; the 0.1 rate mirrors the
+            # hardcoded rates in models/gpt.py and must track them
             rng, ekey = jax.random.split(rng)
             x = nn.Dropout(0.1, deterministic=False).apply(
                 {}, x, rngs={"dropout": ekey})
