@@ -49,7 +49,13 @@ DEFAULT_MATRIX = [
     ("bert_large", 32),
     ("gpt2", 16),
     ("gpt2_medium", 4),
+    ("gpt2_moe", 16),
 ]
+
+# per-model extra flags (best-known single-chip configs, BASELINE.md)
+EXTRA_FLAGS = {
+    "gpt2_moe": ["--attention_impl=flash"],
+}
 
 
 def run_one(model: str, batch: int, warmup: int, batches: int) -> dict:
@@ -57,9 +63,12 @@ def run_one(model: str, batch: int, warmup: int, batches: int) -> dict:
         sys.executable, "-m", "tpu_hc_bench", "1", "0", str(batch), "ici",
         f"--model={model}", "--use_fp16=True",
         f"--num_warmup_batches={warmup}", f"--num_batches={batches}",
+        *EXTRA_FLAGS.get(model, []),
     ]
     t0 = time.time()
     rec: dict = {"model": model, "batch_size": batch}
+    if EXTRA_FLAGS.get(model):
+        rec["flags"] = EXTRA_FLAGS[model]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=1800)

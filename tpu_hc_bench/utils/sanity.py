@@ -96,6 +96,26 @@ def collect_report() -> tuple[list[str], list[str]]:
     except Exception as e:
         failures.append(f"psum smoke test failed: {e}")
 
+    # capability probes: the kernel/primitive surface the framework's
+    # opt-in fast paths need (each degrades gracefully if absent, but the
+    # report should say so up front)
+    lines.append(
+        "ragged_dot (grouped-matmul MoE): "
+        + ("available" if hasattr(jax.lax, "ragged_dot") else "ABSENT")
+    )
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        lines.append("pallas (flash attention, fused xent): importable")
+    except Exception:
+        # informational, not a failure: the default einsum-MoE and dense-
+        # attention paths work without pallas
+        lines.append("pallas (flash attention, fused xent): ABSENT")
+    lines.append(
+        "parallelism: dp (psum/GSPMD/host) + tp/ep (GSPMD model axis) "
+        "+ pp (GPipe pipe axis) + sp (ring/ulysses seq axis)"
+    )
+
     try:
         from tpu_hc_bench import envfile
 
