@@ -149,7 +149,7 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     return heads_to_seq(out)
 
 
-_IMPLS = {"dense", "flash", "ring", "ulysses"}
+_IMPLS = {"dense", "flash", "ring", "ulysses", "ulysses_flash"}
 
 
 def local_attention(q, k, v, impl: str = "dense",
@@ -158,9 +158,13 @@ def local_attention(q, k, v, impl: str = "dense",
     """Dispatch: the one attention entry point model code calls.
 
     ``impl='dense'``/``'flash'`` ignore ``axis_name`` (each shard attends
-    locally — only correct unsharded); ``ring``/``ulysses`` require
-    ``axis_name``.  ``flash`` is the Pallas blocked-softmax kernel
-    (``ops.flash_attention``); ``dense`` is the XLA-compiled reference.
+    locally — only correct unsharded); ``ring``/``ulysses``/
+    ``ulysses_flash`` require ``axis_name``.  ``flash`` is the Pallas
+    blocked-softmax kernel (``ops.flash_attention``); ``dense`` is the
+    XLA-compiled reference; ``ulysses_flash`` composes the all-to-all
+    sequence resharding with the flash kernel for the full-sequence local
+    attention — the long-context production combination (O(S) memory from
+    flash x S-scaling from the seq axis).
     """
     if impl not in _IMPLS:
         raise ValueError(
@@ -176,5 +180,10 @@ def local_attention(q, k, v, impl: str = "dense",
         raise ValueError(f"impl={impl!r} requires axis_name (a bound mesh axis)")
     if impl == "ring":
         return ring_attention(q, k, v, axis_name, causal=causal, scale=scale)
+    if impl == "ulysses_flash":
+        from tpu_hc_bench.ops.flash_attention import flash_attention
+
+        return ulysses_attention(q, k, v, axis_name, causal=causal,
+                                 scale=scale, attn_fn=flash_attention)
     assert impl == "ulysses", impl   # _IMPLS membership checked above
     return ulysses_attention(q, k, v, axis_name, causal=causal, scale=scale)

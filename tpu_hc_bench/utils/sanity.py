@@ -113,7 +113,7 @@ def collect_report() -> tuple[list[str], list[str]]:
         lines.append("pallas (flash attention, fused xent): ABSENT")
     lines.append(
         "parallelism: dp (psum/GSPMD/host) + tp/ep (GSPMD model axis) "
-        "+ pp (GPipe pipe axis) + sp (ring/ulysses seq axis)"
+        "+ pp (GPipe pipe axis) + sp (ring/ulysses/ulysses_flash seq axis)"
     )
 
     try:
