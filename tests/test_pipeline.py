@@ -167,7 +167,7 @@ def test_pp_dropout_mode_trains(devices):
 
 
 def test_pp_flag_exclusivity():
-    with pytest.raises(ValueError, match="combined"):
+    with pytest.raises(ValueError, match="mutually exclusive"):
         flags.BenchmarkConfig(pipeline_parallel=2, model_parallel=2).resolve()
     with pytest.raises(ValueError, match="combined"):
         build_mesh(compute_layout(1, 8, 8), model_parallel=2,

@@ -234,6 +234,30 @@ def test_driver_pipeline_parallel(mesh8):
     assert np.isfinite(res.final_loss)
 
 
+def test_driver_sequence_parallel(mesh8):
+    """--sequence_parallel end-to-end through run_benchmark (DP x SP)."""
+    cfg = tiny_cfg(model="bert_tiny", sequence_parallel=2, batch_size=2,
+                   num_batches=2)
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    text = "\n".join(out)
+    assert "sequence parallel: 2 shards" in text
+    assert "dense->ring" in text
+    assert res.total_images_per_sec > 0
+    assert np.isfinite(res.final_loss)
+
+
+def test_sp_flag_translation_and_guards():
+    cfg = flags.BenchmarkConfig(sequence_parallel=2,
+                                attention_impl="flash").resolve()
+    assert cfg.attention_impl == "ulysses_flash"
+    with pytest.raises(ValueError, match="sequence_parallel"):
+        flags.BenchmarkConfig(attention_impl="ring").resolve()
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        flags.BenchmarkConfig(sequence_parallel=2,
+                              pipeline_parallel=2).resolve()
+
+
 def test_log_name_convention():
     # reference: tfmn-<n>n-<b>b-<data>-<fabric>-r<run>.log (:9-12)
     assert driver.log_name(4, 64, "synthetic", "ici", 1) == \

@@ -140,15 +140,22 @@ class BenchmarkConfig:
                                               # GPT decoder family)
     num_microbatches: int = 0                 # GPipe microbatches per step
                                               # (0 -> 2x pipeline stages)
+    sequence_parallel: int = 1                # sequence shards over the mesh
+                                              # "seq" axis (ring /
+                                              # ulysses[_flash] attention;
+                                              # text models)
     virtual_devices: int | None = None        # debug: provision N virtual
                                               # CPU devices (multi-chip
                                               # paths without hardware)
     gradient_checkpointing: bool = False      # remat transformer layers:
                                               # trade FLOPs for activation
                                               # HBM (long-context headroom)
-    attention_impl: str = "dense"             # dense|flash: transformer
-                                              # attention kernel (flash =
-                                              # Pallas blocked softmax)
+    attention_impl: str = "dense"             # transformer attention kernel:
+                                              # dense|flash single-device
+                                              # (flash = Pallas blocked
+                                              # softmax); ring|ulysses|
+                                              # ulysses_flash under
+                                              # --sequence_parallel
     moe_impl: str = "einsum"                  # einsum|ragged: MoE dispatch
                                               # (einsum = GShard GSPMD/EP;
                                               # ragged = grouped-matmul
@@ -196,11 +203,36 @@ class BenchmarkConfig:
                 "--model_parallel and --expert_parallel are exclusive: both "
                 "shard over the mesh 'model' axis"
             )
-        if self.pipeline_parallel > 1 and (
-                self.model_parallel > 1 or self.expert_parallel > 1):
+        if sum(d > 1 for d in (self.pipeline_parallel, self.model_parallel,
+                               self.expert_parallel,
+                               self.sequence_parallel)) > 1:
             raise ValueError(
-                "--pipeline_parallel cannot be combined with "
-                "--model_parallel/--expert_parallel on the 2-D mesh"
+                "--model_parallel/--expert_parallel/--pipeline_parallel/"
+                "--sequence_parallel are mutually exclusive (one minor "
+                "mesh axis)"
+            )
+        if self.sequence_parallel > 1:
+            note = (
+                f"{self.variable_update}->n/a (sequence_parallel="
+                f"{self.sequence_parallel} runs the dedicated DP x SP "
+                f"shard_map step with dual-axis gradient pmean)"
+            )
+            prior = t.get("variable_update")
+            t["variable_update"] = f"{prior}; {note}" if prior else note
+            # SP needs a sequence-sharded attention impl; translate the
+            # single-device names to their SP counterparts
+            sp_map = {"dense": "ring", "flash": "ulysses_flash"}
+            if self.attention_impl in sp_map:
+                new = sp_map[self.attention_impl]
+                t["attention_impl"] = (
+                    f"{self.attention_impl}->{new} (sequence_parallel="
+                    f"{self.sequence_parallel} shards the sequence axis)"
+                )
+                self.attention_impl = new
+        elif self.attention_impl in ("ring", "ulysses", "ulysses_flash"):
+            raise ValueError(
+                f"--attention_impl={self.attention_impl} requires "
+                f"--sequence_parallel > 1 (it attends across seq shards)"
             )
         if self.moe_impl == "ragged" and (
                 self.expert_parallel > 1 or self.model_parallel > 1):
@@ -252,7 +284,9 @@ class BenchmarkConfig:
                if self.expert_parallel > 1 else "")
             + (f" pipeline_parallel={self.pipeline_parallel}"
                f" num_microbatches={self.num_microbatches or 'auto'}"
-               if self.pipeline_parallel > 1 else ""),
+               if self.pipeline_parallel > 1 else "")
+            + (f" sequence_parallel={self.sequence_parallel}"
+               if self.sequence_parallel > 1 else ""),
         ]
         for k, v in self.translations.items():
             lines.append(f"translated: {k}: {v}")
@@ -310,11 +344,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipeline_parallel", type=int,
                    default=d.pipeline_parallel)
     p.add_argument("--num_microbatches", type=int, default=d.num_microbatches)
+    p.add_argument("--sequence_parallel", type=int,
+                   default=d.sequence_parallel)
     p.add_argument("--virtual_devices", type=int, default=d.virtual_devices)
     p.add_argument("--gradient_checkpointing", type=_parse_bool,
                    default=d.gradient_checkpointing)
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
-                   choices=["dense", "flash"])
+                   choices=["dense", "flash", "ring", "ulysses",
+                            "ulysses_flash"])
     p.add_argument("--moe_impl", type=str, default=d.moe_impl,
                    choices=["einsum", "ragged"])
     return p

@@ -166,16 +166,20 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                  attention_impl: str = "dense", space_to_depth: bool = False,
                  seq_len: int | None = None,
                  gradient_checkpointing: bool = False,
-                 moe_impl: str = "einsum"):
+                 moe_impl: str = "einsum", seq_axis: str | None = None):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
     if spec.moe:
         kwargs["moe_impl"] = moe_impl
     elif moe_impl != "einsum":
         raise ValueError(f"--moe_impl only applies to MoE members, not {name}")
+    if seq_axis is not None and not spec.is_text:
+        raise ValueError(f"--sequence_parallel only applies to text models, "
+                         f"not {name}")
     if spec.is_text:   # attention kernel choice only exists for transformers
         kwargs["attention_impl"] = attention_impl
         kwargs["remat"] = gradient_checkpointing
+        kwargs["seq_axis"] = seq_axis
         if seq_len is not None:
             # long-context override: rescale the linear-in-seq FLOP figure
             # (conservative — ignores the quadratic attention term); the

@@ -168,35 +168,37 @@ class BertMLM(nn.Module):
 
 def bert_base_mlm(num_classes: int = 0, dtype=jnp.float32,
                   attention_impl: str = "dense", max_len: int | None = None,
-                  remat: bool = False):
+                  remat: bool = False, seq_axis: str | None = None):
     """Registry adapter; num_classes is ignored (vocab is the label space).
 
     ``max_len`` only ever *grows* the position table past the canonical 512
     (long-context runs); shorter sequences keep the published shape."""
     del num_classes
     return BertMLM(dtype=dtype, attention_impl=attention_impl,
-                   max_len=max(BERT_MAX_LEN, max_len or 0), remat=remat)
+                   max_len=max(BERT_MAX_LEN, max_len or 0), remat=remat,
+                   seq_axis=seq_axis)
 
 
 def bert_large_mlm(num_classes: int = 0, dtype=jnp.float32,
                    attention_impl: str = "dense", max_len: int | None = None,
-                   remat: bool = False):
+                   remat: bool = False, seq_axis: str | None = None):
     """BERT-large (24L/1024H/16 heads/4096 FFN, ~335M params)."""
     del num_classes
     return BertMLM(
         hidden=1024, num_layers=24, heads=16, ffn=4096,
         max_len=max(BERT_MAX_LEN, max_len or 0),
         dtype=dtype, attention_impl=attention_impl, remat=remat,
+        seq_axis=seq_axis,
     )
 
 
 def bert_tiny_mlm(num_classes: int = 0, dtype=jnp.float32,
                   attention_impl: str = "dense", max_len: int | None = None,
-                  remat: bool = False):
+                  remat: bool = False, seq_axis: str | None = None):
     """4-layer/128-hidden variant for tests and CPU smoke runs."""
     del num_classes
     return BertMLM(
         vocab_size=1024, hidden=128, num_layers=4, heads=4, ffn=512,
         max_len=max(128, max_len or 0), dtype=dtype,
-        attention_impl=attention_impl, remat=remat,
+        attention_impl=attention_impl, remat=remat, seq_axis=seq_axis,
     )

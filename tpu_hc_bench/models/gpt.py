@@ -116,41 +116,47 @@ class GPTLM(nn.Module):
 
 def gpt2(num_classes: int = 0, dtype=jnp.float32,
          attention_impl: str = "dense", max_len: int | None = None,
-         remat: bool = False):
+         remat: bool = False, seq_axis: str | None = None):
     """GPT-2 small (124M); num_classes is ignored (vocab is the space)."""
     del num_classes
     return GPTLM(dtype=dtype, attention_impl=attention_impl,
-                 max_len=max(GPT2_CTX, max_len or 0), remat=remat)
+                 max_len=max(GPT2_CTX, max_len or 0), remat=remat,
+                 seq_axis=seq_axis)
 
 
 def gpt2_medium(num_classes: int = 0, dtype=jnp.float32,
                 attention_impl: str = "dense", max_len: int | None = None,
-                remat: bool = False):
+                remat: bool = False, seq_axis: str | None = None):
     """GPT-2 medium (~355M: 24L/1024H/16 heads)."""
     del num_classes
     return GPTLM(hidden=1024, num_layers=24, heads=16, ffn=4096,
                  dtype=dtype, attention_impl=attention_impl,
-                 max_len=max(GPT2_CTX, max_len or 0), remat=remat)
+                 max_len=max(GPT2_CTX, max_len or 0), remat=remat,
+                 seq_axis=seq_axis)
 
 
 def gpt2_moe(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
-             remat: bool = False, moe_impl: str = "einsum"):
+             remat: bool = False, moe_impl: str = "einsum",
+             seq_axis: str | None = None):
     """GPT-2-small trunk with 8-expert top-2 MoE FFNs (~520M params,
     ~180M active per token: the 124M dense trunk swaps its 57M of FFNs
     for 2x-of-8 expert FFNs) — the expert-parallel workload."""
     del num_classes
     return GPTLM(dtype=dtype, attention_impl=attention_impl,
                  max_len=max(GPT2_CTX, max_len or 0), remat=remat,
-                 num_experts=8, top_k=2, moe_impl=moe_impl)
+                 num_experts=8, top_k=2, moe_impl=moe_impl,
+                 seq_axis=seq_axis)
 
 
 def moe_tiny(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
-             remat: bool = False, moe_impl: str = "einsum"):
+             remat: bool = False, moe_impl: str = "einsum",
+             seq_axis: str | None = None):
     """4-layer/128-hidden 4-expert decoder for tests and CPU smoke runs."""
     del num_classes
     return GPTLM(vocab_size=1024, hidden=128, num_layers=4, heads=4,
                  ffn=256, dtype=dtype, attention_impl=attention_impl,
                  max_len=max(128, max_len or 0), remat=remat,
-                 num_experts=4, top_k=2, moe_impl=moe_impl)
+                 num_experts=4, top_k=2, moe_impl=moe_impl,
+                 seq_axis=seq_axis)

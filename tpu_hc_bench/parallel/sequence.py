@@ -30,7 +30,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-SEQ_AXIS = "seq"
+from tpu_hc_bench.topology import SEQ_AXIS
 
 _NEG_INF = -1e30  # mask value: large-negative, not -inf (keeps exp() clean)
 

@@ -36,6 +36,7 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,6 +144,7 @@ def build_mesh(
     devices: Sequence[jax.Device] | None = None,
     model_parallel: int = 1,
     pipeline_parallel: int = 1,
+    sequence_parallel: int = 1,
 ) -> Mesh:
     """Build the device mesh for this layout.
 
@@ -162,12 +164,18 @@ def build_mesh(
     """
     import numpy as np
 
-    if model_parallel > 1 and pipeline_parallel > 1:
+    if sum(d > 1 for d in
+           (model_parallel, pipeline_parallel, sequence_parallel)) > 1:
         raise ValueError(
-            "model_parallel and pipeline_parallel cannot be combined "
+            "model/pipeline/sequence parallel degrees cannot be combined "
             "on the 2-D mesh (pick one minor axis)")
-    minor = max(model_parallel, pipeline_parallel)
-    minor_name = PIPE_AXIS if pipeline_parallel > 1 else MODEL_AXIS
+    minor = max(model_parallel, pipeline_parallel, sequence_parallel)
+    if pipeline_parallel > 1:
+        minor_name = PIPE_AXIS
+    elif sequence_parallel > 1:
+        minor_name = SEQ_AXIS
+    else:
+        minor_name = MODEL_AXIS
     picked = select_devices(layout, devices)
     n = len(picked)
     if n % minor:

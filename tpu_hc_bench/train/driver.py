@@ -292,34 +292,51 @@ def run_benchmark(
 
     fab = fabric_mod.resolve_fabric(fabric_name)
     layout = layout or discover_layout()
-    # model_parallel (TP), expert_parallel (EP), and pipeline_parallel (PP)
-    # all claim the mesh's minor axis; resolve() enforces their exclusivity
+    # model_parallel (TP), expert_parallel (EP), pipeline_parallel (PP),
+    # and sequence_parallel (SP) all claim the mesh's minor axis;
+    # resolve() enforces their mutual exclusivity
     pp = max(1, getattr(cfg, "pipeline_parallel", 1))
-    mp = max(1, cfg.model_parallel, getattr(cfg, "expert_parallel", 1), pp)
+    sp = max(1, getattr(cfg, "sequence_parallel", 1))
+    mp = max(1, cfg.model_parallel, getattr(cfg, "expert_parallel", 1),
+             pp, sp)
     if layout.total_workers % mp:
         raise ValueError(
-            f"--model_parallel/--expert_parallel/--pipeline_parallel={mp} "
-            f"does not divide {layout.total_workers} workers"
+            f"--model_parallel/--expert_parallel/--pipeline_parallel/"
+            f"--sequence_parallel={mp} does not divide "
+            f"{layout.total_workers} workers"
         )
     if mp > 1 and fab is fabric_mod.Fabric.HOST:
         raise ValueError(
-            "--model_parallel/--expert_parallel/--pipeline_parallel "
-            "requires a device fabric (ici/dcn): the host path's shard_map "
-            "would silently re-replicate the shards"
+            "--model_parallel/--expert_parallel/--pipeline_parallel/"
+            "--sequence_parallel requires a device fabric (ici/dcn): the "
+            "host path's shard_map would silently re-replicate the shards"
         )
-    mesh = build_mesh(layout, model_parallel=mp if pp == 1 else 1,
-                      pipeline_parallel=pp)
-    # with TP/EP/PP, the data-parallel degree (and so the global batch at
-    # fixed per-worker batch) shrinks by the minor-axis degree
+    mesh = build_mesh(layout,
+                      model_parallel=mp if pp == 1 and sp == 1 else 1,
+                      pipeline_parallel=pp, sequence_parallel=sp)
+    # with TP/EP/PP/SP, the data-parallel degree (and so the global batch
+    # at fixed per-worker batch) shrinks by the minor-axis degree
     global_batch = layout.global_batch(cfg.batch_size) // mp
 
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
+    from tpu_hc_bench.topology import SEQ_AXIS
+
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, attention_impl=cfg.attention_impl,
                                space_to_depth=cfg.use_space_to_depth,
                                seq_len=cfg.seq_len,
                                gradient_checkpointing=cfg.gradient_checkpointing,
-                               moe_impl=getattr(cfg, "moe_impl", "einsum"))
+                               moe_impl=getattr(cfg, "moe_impl", "einsum"),
+                               seq_axis=SEQ_AXIS if sp > 1 else None)
+    if sp > 1:
+        seq_len = spec.input_shape[0]
+        if seq_len % sp:
+            raise ValueError(
+                f"sequence length {seq_len} not divisible by "
+                f"sequence_parallel={sp}")
+        if cfg.eval:
+            raise ValueError("--eval with --sequence_parallel is not "
+                             "supported")
 
     # --- banner (reference :52-58 config echo) ---
     for line in layout.summary_lines(fabric=fab.value):
@@ -376,9 +393,15 @@ def run_benchmark(
                              vocab_size=spec.vocab_size,
                              causal_lm=spec.causal_lm)
         batch = ds.batch()
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_hc_bench.topology import DATA_AXIS
+
+        # under SP the [B, S] token batch shards over BOTH mesh axes
+        batch_spec = P(DATA_AXIS, SEQ_AXIS) if sp > 1 else None
 
         def batches():
-            dev_batch = step_mod.shard_batch(batch, mesh)
+            dev_batch = step_mod.shard_batch(batch, mesh, batch_spec)
             while True:
                 yield dev_batch
     else:
@@ -394,7 +417,20 @@ def run_benchmark(
                 yield dev_batch
 
     # --- state + step ---
-    if pp > 1:
+    if sp > 1:
+        print_fn(f"sequence parallel: {sp} shards x "
+                 f"{spec.input_shape[0] // sp} tokens/shard "
+                 f"({cfg.attention_impl})")
+        # init with the unsharded twin (identical params; axis_index needs
+        # a bound mesh axis so the SP model itself can't init here), then
+        # swap in the SP apply
+        init_model = model.clone(attention_impl="dense", seq_axis=None)
+        state = step_mod.make_train_state(init_model, cfg, batch)
+        state = state.replace(apply_fn=model.apply)
+        state = step_mod.replicate_state(state, mesh)
+        train_step = step_mod.build_sp_train_step(mesh, cfg, spec)
+        batch_iter = batches()
+    elif pp > 1:
         if cfg.eval:
             raise ValueError("--eval with --pipeline_parallel is not supported")
         if not spec.causal_lm:

@@ -342,6 +342,63 @@ def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
     return step
 
 
+def build_sp_train_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
+    """DP x SP training step: batch sharded over ``data``, sequence over
+    ``seq`` (``--sequence_parallel``).
+
+    The model was constructed with ``seq_axis=SEQ_AXIS`` so its attention
+    (ring / ulysses / ulysses_flash) and position embeddings are
+    shard-aware; everything else in the step treats the local sequence
+    shard like a shorter sequence.  The device-local loss is the local
+    weighted mean; gradients are pmean'd over BOTH axes (the proven
+    per-rank-seed pattern) — mean-of-shard-means, which differs from the
+    global weighted mean only when shard weight sums differ (MLM's random
+    15% masks; exact for uniform weights).
+    """
+    from tpu_hc_bench.topology import SEQ_AXIS
+
+    is_text = spec.is_text
+
+    def device_step(state: TrainState, batch, dropout_rng):
+        dropout_rng = jax.random.fold_in(
+            dropout_rng, jax.lax.axis_index(DATA_AXIS))
+        dropout_rng = jax.random.fold_in(
+            dropout_rng, jax.lax.axis_index(SEQ_AXIS))
+
+        def loss_fn(p):
+            return _loss_and_updates(state, p, batch, dropout_rng, is_text,
+                                     cfg.fused_xent)
+
+        axes = (DATA_AXIS, SEQ_AXIS)
+        if cfg.forward_only:
+            loss, _ = loss_fn(state.params)
+            return state, {"loss": jax.lax.pmean(loss, axes)}
+        (loss, _), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, axes), grads)
+        loss = jax.lax.pmean(loss, axes)
+        updates, new_opt = state.tx.update(grads, state.opt_state,
+                                           state.params)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            batch_stats={},
+            opt_state=new_opt,
+        )
+        return new_state, {"loss": loss}
+
+    repl = P()
+    both = P(DATA_AXIS, SEQ_AXIS)
+    shard_fn = jax.shard_map(
+        device_step, mesh=mesh,
+        in_specs=(repl, both, repl),
+        out_specs=(repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn, donate_argnums=(0,))
+
+
 def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
     """Eval step (tf_cnn_benchmarks --eval): forward pass, loss + top-1.
 
@@ -494,7 +551,8 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     return jax.device_put(state, sharding)
 
 
-def shard_batch(batch: tuple, mesh: Mesh) -> tuple:
-    """Place a global host batch sharded over the data axis."""
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
+def shard_batch(batch: tuple, mesh: Mesh, spec: P | None = None) -> tuple:
+    """Place a global host batch sharded over the data axis (or ``spec`` —
+    e.g. ``P(DATA_AXIS, SEQ_AXIS)`` for sequence-parallel token batches)."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS) if spec is None else spec)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
