@@ -54,6 +54,8 @@ DEFAULT_MATRIX = [
 
 # per-model extra flags (best-known single-chip configs, BASELINE.md)
 EXTRA_FLAGS = {
+    "gpt2": ["--attention_impl=flash"],
+    "gpt2_medium": ["--attention_impl=flash"],
     "gpt2_moe": ["--attention_impl=flash"],
 }
 

@@ -212,13 +212,15 @@ class BenchmarkConfig:
                 "mesh axis)"
             )
         if self.sequence_parallel > 1:
-            note = (
-                f"{self.variable_update}->n/a (sequence_parallel="
-                f"{self.sequence_parallel} runs the dedicated DP x SP "
-                f"shard_map step with dual-axis gradient pmean)"
-            )
-            prior = t.get("variable_update")
-            t["variable_update"] = f"{prior}; {note}" if prior else note
+            if self.variable_update == "replicated":
+                note = (
+                    f"replicated->psum (sequence_parallel="
+                    f"{self.sequence_parallel} runs the explicit shard_map "
+                    f"step; gradients fuse-psum over both mesh axes)"
+                )
+                prior = t.get("variable_update")
+                t["variable_update"] = f"{prior}; {note}" if prior else note
+                self.variable_update = "psum"
             # SP needs a sequence-sharded attention impl; translate the
             # single-device names to their SP counterparts
             sp_map = {"dense": "ring", "flash": "ulysses_flash"}

@@ -84,7 +84,7 @@ def _flatten_to_buckets(
 
 def fused_psum_tree(
     tree: Any,
-    axis_name: str = DATA_AXIS,
+    axis_name: str | tuple[str, ...] = DATA_AXIS,
     threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
     average: bool = False,
 ) -> Any:
@@ -94,12 +94,18 @@ def fused_psum_tree(
     ``threshold_bytes``, preserving order), reduced with one ``psum`` per
     bucket, then split and reshaped back.  Mixed dtypes within a bucket are
     upcast to the widest float dtype for the wire and cast back on unpack.
+    ``axis_name`` may be a tuple of bound mesh axes (e.g. the DP x SP
+    step reduces over both).
     """
     leaves, treedef = jax.tree.flatten(tree)
     if not leaves:
         return tree
     buckets = _flatten_to_buckets(leaves, threshold_bytes)
-    denom = jax.lax.axis_size(axis_name) if average else 1
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    denom = 1
+    if average:
+        for a in names:
+            denom *= jax.lax.axis_size(a)
     out: list[jax.Array | None] = [None] * len(leaves)
     for bucket in buckets:
         wire_dtype = jnp.result_type(*[leaves[i].dtype for i in bucket])
@@ -123,7 +129,7 @@ def fused_psum_tree(
 
 def allreduce_gradients(
     grads: Any,
-    axis_name: str = DATA_AXIS,
+    axis_name: str | tuple[str, ...] = DATA_AXIS,
     threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
     fuse: bool = True,
 ) -> Any:

@@ -24,7 +24,9 @@ from tpu_hc_bench.flags import BenchmarkConfig
 from tpu_hc_bench.models import create_model
 from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
 from tpu_hc_bench.parallel import fabric as fabric_mod
-from tpu_hc_bench.topology import Layout, build_mesh, discover_layout
+from tpu_hc_bench.topology import (
+    DATA_AXIS, Layout, SEQ_AXIS, build_mesh, discover_layout,
+)
 from tpu_hc_bench.train import step as step_mod
 from tpu_hc_bench.utils import hw
 from tpu_hc_bench.utils.sync import drain
@@ -319,8 +321,6 @@ def run_benchmark(
     global_batch = layout.global_batch(cfg.batch_size) // mp
 
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
-    from tpu_hc_bench.topology import SEQ_AXIS
-
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, attention_impl=cfg.attention_impl,
                                space_to_depth=cfg.use_space_to_depth,
@@ -395,8 +395,6 @@ def run_benchmark(
         batch = ds.batch()
         from jax.sharding import PartitionSpec as P
 
-        from tpu_hc_bench.topology import DATA_AXIS
-
         # under SP the [B, S] token batch shards over BOTH mesh axes
         batch_spec = P(DATA_AXIS, SEQ_AXIS) if sp > 1 else None
 
@@ -428,7 +426,9 @@ def run_benchmark(
         state = step_mod.make_train_state(init_model, cfg, batch)
         state = state.replace(apply_fn=model.apply)
         state = step_mod.replicate_state(state, mesh)
-        train_step = step_mod.build_sp_train_step(mesh, cfg, spec)
+        # the shared psum step builder handles SP (axes = (data, seq),
+        # fusion buckets reduce over both)
+        train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
         batch_iter = batches()
     elif pp > 1:
         if cfg.eval:

@@ -175,11 +175,20 @@ def build_train_step(
     if cfg.variable_update == "replicated":
         return _build_gspmd_step(mesh, cfg, is_text)
 
+    # --sequence_parallel: same explicit-psum step over a (data, seq) mesh
+    # — batch sharded over both axes, gradients reduced (with the same
+    # fusion buckets) over both; the model was built seq-axis-aware
+    from tpu_hc_bench.topology import SEQ_AXIS
+
+    sp = getattr(cfg, "sequence_parallel", 1) > 1
+    axes = (DATA_AXIS, SEQ_AXIS) if sp else (DATA_AXIS,)
+
     def device_step(state: TrainState, batch, dropout_rng):
         # per-device: local shard of the batch, replicated state
-        dropout_rng = jax.random.fold_in(
-            dropout_rng, jax.lax.axis_index(DATA_AXIS)
-        )
+        for a in axes:
+            dropout_rng = jax.random.fold_in(
+                dropout_rng, jax.lax.axis_index(a)
+            )
 
         def loss_fn(p):
             return _loss_and_updates(state, p, batch, dropout_rng, is_text,
@@ -190,14 +199,15 @@ def build_train_step(
         )(state.params)
         grads = allreduce_gradients(
             grads,
+            axis_name=axes,
             threshold_bytes=cfg.fusion_threshold_bytes,
             fuse=fuse,
         )
-        loss = jax.lax.pmean(loss, DATA_AXIS)
+        loss = jax.lax.pmean(loss, axes)
         if new_stats:
             # sync running stats so replicated state stays identical
             new_stats = jax.tree.map(
-                lambda s: jax.lax.pmean(s, DATA_AXIS), new_stats
+                lambda s: jax.lax.pmean(s, axes), new_stats
             )
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -211,15 +221,19 @@ def build_train_step(
 
     if cfg.forward_only:
         def fwd_only(state, batch, dropout_rng):
+            for a in axes:
+                dropout_rng = jax.random.fold_in(
+                    dropout_rng, jax.lax.axis_index(a)
+                )
             loss, _ = _loss_and_updates(
                 state, state.params, batch, dropout_rng, is_text,
                 cfg.fused_xent,
             )
-            return state, {"loss": jax.lax.pmean(loss, DATA_AXIS)}
+            return state, {"loss": jax.lax.pmean(loss, axes)}
         device_step = fwd_only
 
     replicated = P()
-    sharded = P(DATA_AXIS)
+    sharded = P(*axes)
     shard_fn = jax.shard_map(
         device_step,
         mesh=mesh,
@@ -340,63 +354,6 @@ def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
         return state, {"loss": jnp.asarray(np.mean(jax.device_get(losses)))}
 
     return step
-
-
-def build_sp_train_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
-    """DP x SP training step: batch sharded over ``data``, sequence over
-    ``seq`` (``--sequence_parallel``).
-
-    The model was constructed with ``seq_axis=SEQ_AXIS`` so its attention
-    (ring / ulysses / ulysses_flash) and position embeddings are
-    shard-aware; everything else in the step treats the local sequence
-    shard like a shorter sequence.  The device-local loss is the local
-    weighted mean; gradients are pmean'd over BOTH axes (the proven
-    per-rank-seed pattern) — mean-of-shard-means, which differs from the
-    global weighted mean only when shard weight sums differ (MLM's random
-    15% masks; exact for uniform weights).
-    """
-    from tpu_hc_bench.topology import SEQ_AXIS
-
-    is_text = spec.is_text
-
-    def device_step(state: TrainState, batch, dropout_rng):
-        dropout_rng = jax.random.fold_in(
-            dropout_rng, jax.lax.axis_index(DATA_AXIS))
-        dropout_rng = jax.random.fold_in(
-            dropout_rng, jax.lax.axis_index(SEQ_AXIS))
-
-        def loss_fn(p):
-            return _loss_and_updates(state, p, batch, dropout_rng, is_text,
-                                     cfg.fused_xent)
-
-        axes = (DATA_AXIS, SEQ_AXIS)
-        if cfg.forward_only:
-            loss, _ = loss_fn(state.params)
-            return state, {"loss": jax.lax.pmean(loss, axes)}
-        (loss, _), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        grads = jax.tree.map(lambda g: jax.lax.pmean(g, axes), grads)
-        loss = jax.lax.pmean(loss, axes)
-        updates, new_opt = state.tx.update(grads, state.opt_state,
-                                           state.params)
-        new_state = state.replace(
-            step=state.step + 1,
-            params=optax.apply_updates(state.params, updates),
-            batch_stats={},
-            opt_state=new_opt,
-        )
-        return new_state, {"loss": loss}
-
-    repl = P()
-    both = P(DATA_AXIS, SEQ_AXIS)
-    shard_fn = jax.shard_map(
-        device_step, mesh=mesh,
-        in_specs=(repl, both, repl),
-        out_specs=(repl, repl),
-        check_vma=False,
-    )
-    return jax.jit(shard_fn, donate_argnums=(0,))
 
 
 def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
