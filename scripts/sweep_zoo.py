@@ -50,6 +50,7 @@ DEFAULT_MATRIX = [
     ("gpt2", 16),
     ("gpt2_medium", 4),
     ("gpt2_moe", 16),
+    ("llama_1b", 2),
 ]
 
 # per-model extra flags (best-known single-chip configs, BASELINE.md)
@@ -57,6 +58,7 @@ EXTRA_FLAGS = {
     "gpt2": ["--attention_impl=flash"],
     "gpt2_medium": ["--attention_impl=flash"],
     "gpt2_moe": ["--attention_impl=flash"],
+    "llama_1b": ["--attention_impl=flash"],
 }
 
 

@@ -105,3 +105,27 @@ def test_allreduce_gradients_both_paths(mesh8):
 
 def test_fused_empty_tree_is_noop(mesh8):
     assert collectives.fused_psum_tree({}) == {}
+
+
+def test_fused_psum_tree_dual_axis(devices):
+    """Fusion buckets reduce over a tuple of mesh axes (the DP x SP path)."""
+    from jax.sharding import Mesh
+
+    from tpu_hc_bench.topology import SEQ_AXIS
+
+    mesh = Mesh(np.array(devices).reshape(4, 2), (DATA_AXIS, SEQ_AXIS))
+    tree = {"a": jnp.arange(8.0).reshape(4, 2), "b": jnp.ones((4, 2))}
+
+    def f(t):
+        return collectives.fused_psum_tree(
+            t, axis_name=(DATA_AXIS, SEQ_AXIS), threshold_bytes=1 << 20,
+            average=True)
+
+    spec = P(DATA_AXIS, SEQ_AXIS)
+    out = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(spec,), out_specs=P(),
+        check_vma=False))(tree)
+    # average over all 8 shards: every leaf equals the global mean of its
+    # per-shard values (each shard holds one scalar here)
+    np.testing.assert_allclose(float(out["a"][0, 0]), np.arange(8.0).mean())
+    np.testing.assert_allclose(float(out["b"][0, 0]), 1.0)

@@ -51,7 +51,7 @@ class ModelSpec:
 def _registry() -> dict[str, ModelSpec]:
     from tpu_hc_bench.models import (
         alexnet, bert, cifar_resnet, densenet, googlenet, gpt, inception,
-        mobilenet, nasnet, resnet, small_cnns, vgg,
+        llama, mobilenet, nasnet, resnet, small_cnns, vgg,
     )
 
     specs = [
@@ -128,6 +128,11 @@ def _registry() -> dict[str, ModelSpec]:
                   moe=True),
         ModelSpec("moe_tiny", gpt.moe_tiny, (64,), 2 * 3e6 * 64,
                   is_text=True, vocab_size=1024, causal_lm=True, moe=True),
+        # modern decoder family: RMSNorm + RoPE + SwiGLU + GQA
+        ModelSpec("llama_1b", llama.llama_1b, (2048,), 2 * 1.1e9 * 2048,
+                  is_text=True, vocab_size=32000, causal_lm=True),
+        ModelSpec("llama_tiny", llama.llama_tiny, (64,), 2 * 1.5e6 * 64,
+                  is_text=True, vocab_size=1024, causal_lm=True),
     ]
     return {s.name: s for s in specs}
 
