@@ -53,17 +53,55 @@ WORKER = textwrap.dedent("""
 """)
 
 
+PP_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from tpu_hc_bench.parallel import distributed
+    from tpu_hc_bench import topology
+
+    port = int(sys.argv[1])
+    distributed.initialize(coordinator_port=port)
+    assert jax.process_count() == 2 and jax.device_count() == 4
+
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.data.synthetic import SyntheticTokens
+    from tpu_hc_bench.models.gpt import GPTLM
+    from tpu_hc_bench.parallel import pipeline as pp
+
+    layout = topology.discover_layout(workers_per_host=0)
+    # minor (pipe) axis = adjacent chips -> intra-host ppermute hops;
+    # the data axis crosses the two processes (the DCN analog)
+    mesh = topology.build_mesh(layout, pipeline_parallel=2)
+    cfg = flags.BenchmarkConfig(model="gpt2", batch_size=2,
+                                pipeline_parallel=2).resolve()
+    model = GPTLM(vocab_size=64, hidden=32, num_layers=2, heads=4, ffn=64,
+                  max_len=16)
+    batch = SyntheticTokens(4, 16, vocab_size=64, causal_lm=True).batch()
+    params, opt_state = pp.make_pp_state(model, cfg, batch[0], mesh)
+    step, _ = pp.build_pp_train_step(mesh, model, cfg, 2, params, opt_state,
+                                     deterministic=True)
+    params, opt_state, loss = step(params, opt_state, batch)
+    loss = float(jax.device_get(loss))
+    assert loss == loss, "pp loss is NaN"
+    print(f"MP_PP_OK process={jax.process_index()} loss={loss:.4f}",
+          flush=True)
+""")
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-def test_two_process_hostfile_allreduce(tmp_path):
+def _run_two_workers(tmp_path, worker_src, ok_marker):
     hostfile = tmp_path / "nodeips.txt"
     hostfile.write_text("127.0.0.1\n127.0.0.1\n")
     script = tmp_path / "worker.py"
-    script.write_text(WORKER)
+    script.write_text(worker_src)
     port = free_port()
 
     procs = []
@@ -107,4 +145,14 @@ def test_two_process_hostfile_allreduce(tmp_path):
         pytest.fail("worker timed out; captured output:\n" + "\n---\n".join(outs))
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
-        assert "MP_OK" in out
+        assert ok_marker in out
+
+
+def test_two_process_hostfile_allreduce(tmp_path):
+    _run_two_workers(tmp_path, WORKER, "MP_OK")
+
+
+def test_two_process_pipeline_step(tmp_path):
+    """DP x PP across 2 processes: pipe hops intra-process, the data-axis
+    gradient psum crosses the process boundary (the DCN analog)."""
+    _run_two_workers(tmp_path, PP_WORKER, "MP_PP_OK")

@@ -131,7 +131,8 @@ def _registry() -> dict[str, ModelSpec]:
         # modern decoder family: RMSNorm + RoPE + SwiGLU + GQA
         ModelSpec("llama_1b", llama.llama_1b, (2048,), 2 * 1.1e9 * 2048,
                   is_text=True, vocab_size=32000, causal_lm=True),
-        ModelSpec("llama_tiny", llama.llama_tiny, (64,), 2 * 1.5e6 * 64,
+        # ~0.8M params: embed 131k + untied head 131k + 4 layers x ~136k
+        ModelSpec("llama_tiny", llama.llama_tiny, (64,), 2 * 0.8e6 * 64,
                   is_text=True, vocab_size=1024, causal_lm=True),
     ]
     return {s.name: s for s in specs}

@@ -9,11 +9,11 @@ GPT-2/BERT classics.  TPU-first choices:
   ``global_position_ids``, so it is sequence-parallel-aware for free
   (each seq shard rotates by its global offset).
 - **GQA**: ``num_kv_heads < heads`` shrinks the KV projection params; the
-  KV heads are repeated up to the query-head count *before* the attention
-  dispatch, so every impl (dense / Pallas flash / ring / ulysses) works
-  unchanged — the MXU work equals MHA, only params/HBM traffic shrink
-  (the serving-time KV-cache benefit; for training the win is parameter
-  traffic).
+  attention dispatch broadcasts KV heads to the query-head count
+  (``kv_repeat``) — up front for the single-device impls, but *after or
+  inside the collective* for ring/ulysses, so sequence parallelism moves
+  only the un-repeated KV bytes over the fabric.  MXU work equals MHA;
+  params and SP wire traffic shrink.
 - **SwiGLU** gate/up/down projections are three MXU-shaped matmuls;
   RMSNorm statistics accumulate in f32 (bf16-safe).
 - Untied LM head (Llama convention), computed with compute-dtype operands
@@ -92,15 +92,14 @@ class LlamaAttention(nn.Module):
         pos = global_position_ids(x.shape[1], self.seq_axis, self.max_len)
         q = apply_rope(q, pos)
         k = apply_rope(k, pos)
-        # GQA: repeat KV heads to the query-head count so the attention
-        # dispatch (dense/flash/ring/ulysses) sees plain MHA shapes
-        if group > 1:
-            k = jnp.repeat(k, group, axis=2)
-            v = jnp.repeat(v, group, axis=2)
+        # GQA: the dispatch broadcasts KV heads to the query-head count —
+        # up front for single-device impls, after/inside the collective
+        # for sequence-parallel ones (un-repeated KV bytes on the wire)
         from tpu_hc_bench.parallel.sequence import local_attention
 
         out = local_attention(q, k, v, impl=self.attention_impl,
-                              axis_name=self.seq_axis, causal=True)
+                              axis_name=self.seq_axis, causal=True,
+                              kv_repeat=group)
         return nn.DenseGeneral(self.hidden, axis=(-2, -1), use_bias=False,
                                dtype=self.dtype, name="wo")(out)
 

@@ -57,7 +57,7 @@ def dense_attention(q, k, v, causal: bool = False, scale: float | None = None,
 
 
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
-                   scale: float | None = None):
+                   scale: float | None = None, kv_repeat: int = 1):
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
     Must run inside ``shard_map`` with ``axis_name`` bound; q/k/v are the
@@ -66,6 +66,10 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     ``axis_size`` steps folds one K/V block into the online-softmax
     accumulator (running max ``m``, normalizer ``l``, weighted sum ``o`` —
     all float32).  Equivalent to dense attention over the global sequence.
+
+    ``kv_repeat > 1`` (GQA): k/v carry ``heads / kv_repeat`` KV heads and
+    are broadcast up to the query-head count *inside each fold* — the
+    ring only ever moves the un-repeated KV bytes.
     """
     from tpu_hc_bench.parallel.collectives import ppermute_ring
 
@@ -78,6 +82,10 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     qpos = my * lq + jnp.arange(lq)                       # global query rows
 
     def fold(carry, k_blk, v_blk, src):
+        if kv_repeat > 1:
+            # block-local broadcast: no extra ring traffic
+            k_blk = jnp.repeat(k_blk, kv_repeat, axis=2)
+            v_blk = jnp.repeat(v_blk, kv_repeat, axis=2)
         m, l, o = carry
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
                        preferred_element_type=jnp.float32) * scale
@@ -117,7 +125,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
 
 def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
                       causal: bool = False, scale: float | None = None,
-                      attn_fn=None):
+                      attn_fn=None, kv_repeat: int = 1):
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
 
     Re-shards [batch, local_seq, heads, head_dim] -> [batch, global_seq,
@@ -126,23 +134,39 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     ``heads % axis_size == 0``.  ``attn_fn(q, k, v, causal=..., scale=...)``
     (always called with those keywords forwarded) overrides the local
     attention (e.g. a Pallas flash kernel); default is ``dense_attention``.
+
+    ``kv_repeat > 1`` (GQA): k/v carry ``heads / kv_repeat`` KV heads and
+    are exchanged un-repeated (needs ``kv_heads % axis_size == 0`` too),
+    then broadcast to the local query-head count after the reshard — the
+    all_to_all only ever moves the un-repeated KV bytes.
     """
     n = jax.lax.axis_size(axis_name)
     h = q.shape[2]
+    h_kv = k.shape[2]
     if h % n:
         raise ValueError(f"heads={h} not divisible by axis size {n}")
+    if kv_repeat > 1 and h_kv % n:
+        raise ValueError(
+            f"kv heads={h_kv} not divisible by axis size {n}")
 
     def seq_to_heads(x):
-        # split_axis/concat_axis shifted by 1 for the leading stack dim
-        return jax.lax.all_to_all(x, axis_name, split_axis=3, concat_axis=2,
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True)
 
     def heads_to_seq(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    # one stacked exchange for q/k/v instead of three collective launches
-    qg, kg, vg = seq_to_heads(jnp.stack((q, k, v)))
+    if kv_repeat > 1:
+        qg = seq_to_heads(q)
+        kg = jnp.repeat(seq_to_heads(k), kv_repeat, axis=2)
+        vg = jnp.repeat(seq_to_heads(v), kv_repeat, axis=2)
+    else:
+        # one stacked exchange for q/k/v instead of three collective
+        # launches (split/concat shifted by 1 for the leading stack dim)
+        qg, kg, vg = jax.lax.all_to_all(
+            jnp.stack((q, k, v)), axis_name, split_axis=3, concat_axis=2,
+            tiled=True)
     if attn_fn is None:
         attn_fn = dense_attention
     out = attn_fn(qg, kg, vg, causal=causal, scale=scale)
@@ -154,7 +178,7 @@ _IMPLS = {"dense", "flash", "ring", "ulysses", "ulysses_flash"}
 
 def local_attention(q, k, v, impl: str = "dense",
                     axis_name: str | None = None, causal: bool = False,
-                    scale: float | None = None):
+                    scale: float | None = None, kv_repeat: int = 1):
     """Dispatch: the one attention entry point model code calls.
 
     ``impl='dense'``/``'flash'`` ignore ``axis_name`` (each shard attends
@@ -165,11 +189,19 @@ def local_attention(q, k, v, impl: str = "dense",
     sequence resharding with the flash kernel for the full-sequence local
     attention — the long-context production combination (O(S) memory from
     flash x S-scaling from the seq axis).
+
+    ``kv_repeat > 1`` (GQA): k/v arrive with ``heads / kv_repeat`` KV
+    heads.  The single-device impls broadcast them up front (pure compute
+    reshape); the sequence-parallel impls move the un-repeated KV bytes
+    over the fabric and broadcast after/inside the collective.
     """
     if impl not in _IMPLS:
         raise ValueError(
             f"unknown attention impl {impl!r}; have {sorted(_IMPLS)}"
         )
+    if impl in ("dense", "flash") and kv_repeat > 1:
+        k = jnp.repeat(k, kv_repeat, axis=2)
+        v = jnp.repeat(v, kv_repeat, axis=2)
     if impl == "dense":
         return dense_attention(q, k, v, causal=causal, scale=scale)
     if impl == "flash":
@@ -179,11 +211,14 @@ def local_attention(q, k, v, impl: str = "dense",
     if axis_name is None:
         raise ValueError(f"impl={impl!r} requires axis_name (a bound mesh axis)")
     if impl == "ring":
-        return ring_attention(q, k, v, axis_name, causal=causal, scale=scale)
+        return ring_attention(q, k, v, axis_name, causal=causal, scale=scale,
+                              kv_repeat=kv_repeat)
     if impl == "ulysses_flash":
         from tpu_hc_bench.ops.flash_attention import flash_attention
 
         return ulysses_attention(q, k, v, axis_name, causal=causal,
-                                 scale=scale, attn_fn=flash_attention)
+                                 scale=scale, attn_fn=flash_attention,
+                                 kv_repeat=kv_repeat)
     assert impl == "ulysses", impl   # _IMPLS membership checked above
-    return ulysses_attention(q, k, v, axis_name, causal=causal, scale=scale)
+    return ulysses_attention(q, k, v, axis_name, causal=causal, scale=scale,
+                             kv_repeat=kv_repeat)
