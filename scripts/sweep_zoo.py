@@ -44,6 +44,7 @@ DEFAULT_MATRIX = [
     ("vgg16", 128),
     ("vgg19", 128),
     ("inception3", 128),
+    ("vit_b16", 128),
     ("inception4", 64),
     ("bert_base", 128),
     ("bert_large", 32),

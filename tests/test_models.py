@@ -333,3 +333,44 @@ def test_gradient_checkpointing_matches():
             == jax.tree_util.tree_structure(g1))
     for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_vit_b16_params():
+    from tpu_hc_bench.models import vit
+
+    model = vit.vit_b16()
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    count = n_params(variables["params"])
+    # ViT-B/16 ~86M (patchify + 12 encoder layers + head)
+    assert 82e6 < count < 92e6, count
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+    assert out.dtype == jnp.float32
+
+
+def test_vit_tiny_trains_and_flash_matches_dense():
+    from tpu_hc_bench.models import vit
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    dense_model, _ = models.create_model("vit_tiny", num_classes=10)
+    flash_model, _ = models.create_model("vit_tiny", num_classes=10,
+                                         attention_impl="flash")
+    variables = dense_model.init(jax.random.PRNGKey(1), x, train=False)
+    ref = dense_model.apply(variables, x, train=False)
+    out = flash_model.apply(variables, x, train=False)
+    # seq 17 (16 patches + cls): flash pads to its block size; outputs match
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    # train mode runs with dropout
+    out = dense_model.apply(variables, x, train=True,
+                            rngs={"dropout": jax.random.PRNGKey(2)})
+    assert out.shape == (2, 10)
+
+
+def test_vit_remat_accepted():
+    model, _ = models.create_model("vit_tiny", num_classes=10,
+                                   gradient_checkpointing=True)
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert model.apply(variables, x, train=False).shape == (1, 10)

@@ -46,12 +46,14 @@ class ModelSpec:
     vocab_size: int = 30522            # text models: synthetic-data label space
     causal_lm: bool = False            # text models: next-token objective
     moe: bool = False                  # factory accepts moe_impl
+    attention: bool = False            # image transformer (ViT): factory
+                                       # accepts attention_impl/remat
 
 
 def _registry() -> dict[str, ModelSpec]:
     from tpu_hc_bench.models import (
         alexnet, bert, cifar_resnet, densenet, googlenet, gpt, inception,
-        llama, mobilenet, nasnet, resnet, small_cnns, vgg,
+        llama, mobilenet, nasnet, resnet, small_cnns, vgg, vit,
     )
 
     specs = [
@@ -105,6 +107,13 @@ def _registry() -> dict[str, ModelSpec]:
         ModelSpec("vgg11", vgg.vgg11, (224, 224, 3), 15.2e9),
         ModelSpec("vgg16", vgg.vgg16, (224, 224, 3), 30.9e9),
         ModelSpec("vgg19", vgg.vgg19, (224, 224, 3), 39.3e9),
+        # ViT-B/16: 17.6G multiply-adds at 224^2 (the figure papers quote)
+        # -> 35.2e9 under this registry's 2*MACs convention
+        ModelSpec("vit_b16", vit.vit_b16, (224, 224, 3), 35.2e9,
+                  attention=True),
+        # 2*MACs at 32^2/patch-8: 17 tokens x 4 layers + patchify + head
+        ModelSpec("vit_tiny", vit.vit_tiny, (32, 32, 3), 5.3e6,
+                  default_image_size=32, attention=True),
         ModelSpec("inception3", inception.inception_v3, (299, 299, 3), 11.4e9,
                   default_image_size=299),
         ModelSpec("inception4", inception.inception_v4, (299, 299, 3), 24.5e9,
@@ -182,9 +191,10 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
     if seq_axis is not None and not spec.is_text:
         raise ValueError(f"--sequence_parallel only applies to text models, "
                          f"not {name}")
-    if spec.is_text:   # attention kernel choice only exists for transformers
+    if spec.attention or spec.is_text:  # transformers: kernel + remat knobs
         kwargs["attention_impl"] = attention_impl
         kwargs["remat"] = gradient_checkpointing
+    if spec.is_text:
         kwargs["seq_axis"] = seq_axis
         if seq_len is not None:
             # long-context override: rescale the linear-in-seq FLOP figure
@@ -197,7 +207,7 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                 * seq_len / spec.input_shape[0],
             )
     else:
-        if gradient_checkpointing:
+        if gradient_checkpointing and not spec.attention:
             raise ValueError(
                 "--gradient_checkpointing currently applies to transformer "
                 f"members only, not {name}")

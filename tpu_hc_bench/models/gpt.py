@@ -43,6 +43,7 @@ class DecoderLayer(nn.Module):
     num_experts: int = 0
     top_k: int = 2
     moe_impl: str = "einsum"
+    causal: bool = True                # ViT reuses this block bidirectional
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -50,7 +51,7 @@ class DecoderLayer(nn.Module):
         h = MultiHeadAttention(
             self.hidden, self.heads, dtype=self.dtype,
             attention_impl=self.attention_impl, seq_axis=self.seq_axis,
-            causal=True,
+            causal=self.causal,
         )(h)
         x = x + nn.Dropout(0.1, deterministic=not train)(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)

@@ -1,0 +1,82 @@
+"""Vision Transformer (ViT-B/16) in Flax — the modern ImageNet member.
+
+Beyond-reference member (the reference's zoo is conv-era CNNs driven
+through tf_cnn_benchmarks — SURVEY.md §2b #22): ViT bridges the CNN zoo
+and the transformer stack, reusing the framework's attention dispatch so
+``--attention_impl=flash`` applies to an image model too.
+
+TPU-first notes: patchify is one stride-16 conv (a [256·3, 768]-shaped
+matmul per patch — MXU-native, unlike the tiny 7x7 CNN stems); the
+encoder is pre-LN with learned position embeddings and a class token;
+all matmuls are MXU-shaped at hidden 768.  Sequence length is 197
+(196 patches + cls), far below where sequence parallelism pays, so the
+ViT members are data/tensor-parallel workloads.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# the pre-LN encoder block is gpt.DecoderLayer with causal=False — one
+# block implementation serves GPT, MoE-GPT, and ViT
+from tpu_hc_bench.models.gpt import DecoderLayer
+
+
+class ViT(nn.Module):
+    """ViT: patchify conv -> cls token + pos embed -> pre-LN encoder ->
+    LN -> cls-token classification head."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    hidden: int = 768
+    num_layers: int = 12
+    heads: int = 12
+    ffn: int = 3072
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b = x.shape[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.hidden, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, name="patchify")(x)
+        x = x.reshape(b, -1, self.hidden)            # [B, patches, H]
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.hidden))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype),
+                              (b, 1, self.hidden)), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.hidden))
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(0.1, deterministic=not train)(x)
+        layer_cls = (nn.remat(DecoderLayer, static_argnums=(2,))
+                     if self.remat else DecoderLayer)
+        for i in range(self.num_layers):
+            x = layer_cls(self.hidden, self.heads, self.ffn,
+                          dtype=self.dtype, causal=False,
+                          attention_impl=self.attention_impl,
+                          name=f"layer_{i}")(x, train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0])
+
+
+def vit_b16(num_classes: int = 1000, dtype=jnp.float32,
+            attention_impl: str = "dense", remat: bool = False):
+    """ViT-Base/16 (12L/768H/12 heads, ~86M params at 1000 classes)."""
+    return ViT(num_classes=num_classes, dtype=dtype,
+               attention_impl=attention_impl, remat=remat)
+
+
+def vit_tiny(num_classes: int = 1000, dtype=jnp.float32,
+             attention_impl: str = "dense", remat: bool = False):
+    """4-layer/64-hidden patch-8 variant for tests and CPU smoke runs."""
+    return ViT(num_classes=num_classes, patch=8, hidden=64, num_layers=4,
+               heads=4, ffn=128, dtype=dtype, attention_impl=attention_impl,
+               remat=remat)
