@@ -45,6 +45,7 @@ DEFAULT_MATRIX = [
     ("vgg19", 128),
     ("inception3", 128),
     ("vit_b16", 128),
+    ("vit_l16", 64),
     ("inception4", 64),
     ("bert_base", 128),
     ("bert_large", 32),

@@ -374,3 +374,15 @@ def test_vit_remat_accepted():
     x = jnp.zeros((1, 32, 32, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     assert model.apply(variables, x, train=False).shape == (1, 10)
+
+
+def test_vit_l16_params():
+    from tpu_hc_bench.models import vit
+
+    model = vit.vit_l16()
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    count = n_params(variables["params"])
+    # ViT-L/16 ~304M
+    assert 295e6 < count < 315e6, count
+    assert model.apply(variables, x, train=False).shape == (1, 1000)

@@ -17,17 +17,18 @@ from tpu_hc_bench.topology import MODEL_AXIS, build_mesh, compute_layout
 from tpu_hc_bench.train import step as step_mod
 
 
-def _setup(model_parallel, devices, batch=8):
+def _setup(model_parallel, devices, batch=8, model_name="bert_tiny",
+           num_classes=1000, make_batch=None):
     layout = compute_layout(num_hosts=1, workers_per_host=len(devices),
                             chips_per_host=len(devices))
     mesh = build_mesh(layout, model_parallel=model_parallel)
     cfg = flags.BenchmarkConfig(
-        model="bert_tiny", batch_size=1, variable_update="replicated",
-        model_parallel=model_parallel,
+        model=model_name, batch_size=1, variable_update="replicated",
+        model_parallel=model_parallel, num_classes=num_classes,
     ).resolve()
-    model, spec = create_model("bert_tiny")
-    ds = SyntheticTokens(batch, 32, vocab_size=1024, seed=0)
-    raw = ds.batch()
+    model, spec = create_model(model_name, num_classes=num_classes)
+    raw = (make_batch(batch) if make_batch is not None
+           else SyntheticTokens(batch, 32, vocab_size=1024, seed=0).batch())
     state = step_mod.make_train_state(model, cfg, raw)
     if model_parallel > 1:
         state = step_mod.shard_state_tp(state, mesh)
@@ -60,6 +61,30 @@ def test_tp_matches_replicated(devices):
     for state, train_step, batch in ((state_r, step_r, batch_r),
                                      (state_t, step_t, batch_t)):
         for _ in range(3):
+            state, metrics = train_step(state, batch, rng)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
+
+
+def test_vit_tp_matches_replicated(devices):
+    """ViT is tensor-parallel for free: its encoder block shares the
+    qkv/out/fc/proj param names the Megatron TP rules match."""
+    from tpu_hc_bench.data.synthetic import SyntheticImages
+
+    def images(batch):
+        return SyntheticImages(batch, (32, 32, 3), num_classes=10).batch()
+
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for mp in (1, 2):
+        state, train_step, batch = _setup(
+            mp, devices, model_name="vit_tiny", num_classes=10,
+            make_batch=images)
+        if mp > 1:
+            qkv = state.params["layer_0"]["MultiHeadAttention_0"]["qkv"][
+                "kernel"]
+            assert MODEL_AXIS in qkv.sharding.spec
+        for _ in range(2):
             state, metrics = train_step(state, batch, rng)
         losses.append(float(jax.device_get(metrics["loss"])))
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)

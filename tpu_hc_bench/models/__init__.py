@@ -111,6 +111,9 @@ def _registry() -> dict[str, ModelSpec]:
         # -> 35.2e9 under this registry's 2*MACs convention
         ModelSpec("vit_b16", vit.vit_b16, (224, 224, 3), 35.2e9,
                   attention=True),
+        # ViT-L/16: ~61.6G multiply-adds at 224^2 -> 2*MACs
+        ModelSpec("vit_l16", vit.vit_l16, (224, 224, 3), 123.2e9,
+                  attention=True),
         # 2*MACs at 32^2/patch-8: 17 tokens x 4 layers + patchify + head
         ModelSpec("vit_tiny", vit.vit_tiny, (32, 32, 3), 5.3e6,
                   default_image_size=32, attention=True),

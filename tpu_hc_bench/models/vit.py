@@ -1,16 +1,19 @@
-"""Vision Transformer (ViT-B/16) in Flax — the modern ImageNet member.
+"""Vision Transformers (ViT-B/16, ViT-L/16) in Flax — modern ImageNet
+members.
 
-Beyond-reference member (the reference's zoo is conv-era CNNs driven
+Beyond-reference members (the reference's zoo is conv-era CNNs driven
 through tf_cnn_benchmarks — SURVEY.md §2b #22): ViT bridges the CNN zoo
 and the transformer stack, reusing the framework's attention dispatch so
 ``--attention_impl=flash`` applies to an image model too.
 
-TPU-first notes: patchify is one stride-16 conv (a [256·3, 768]-shaped
-matmul per patch — MXU-native, unlike the tiny 7x7 CNN stems); the
-encoder is pre-LN with learned position embeddings and a class token;
-all matmuls are MXU-shaped at hidden 768.  Sequence length is 197
-(196 patches + cls), far below where sequence parallelism pays, so the
-ViT members are data/tensor-parallel workloads.
+TPU-first notes: patchify is one stride-16 conv (a [patch²·3, hidden]-
+shaped matmul per patch — MXU-native, unlike the tiny 7x7 CNN stems);
+the encoder is pre-LN with learned position embeddings and a class
+token; all matmuls are MXU-shaped (hidden 768/1024).  Sequence length is
+197 (196 patches + cls), far below where sequence parallelism pays, so
+the ViT members are data/tensor-parallel workloads (tensor parallelism
+works unchanged — the shared encoder block carries the param names the
+Megatron TP rules match).
 """
 
 from __future__ import annotations
@@ -71,6 +74,14 @@ def vit_b16(num_classes: int = 1000, dtype=jnp.float32,
             attention_impl: str = "dense", remat: bool = False):
     """ViT-Base/16 (12L/768H/12 heads, ~86M params at 1000 classes)."""
     return ViT(num_classes=num_classes, dtype=dtype,
+               attention_impl=attention_impl, remat=remat)
+
+
+def vit_l16(num_classes: int = 1000, dtype=jnp.float32,
+            attention_impl: str = "dense", remat: bool = False):
+    """ViT-Large/16 (24L/1024H/16 heads, ~304M params)."""
+    return ViT(num_classes=num_classes, hidden=1024, num_layers=24,
+               heads=16, ffn=4096, dtype=dtype,
                attention_impl=attention_impl, remat=remat)
 
 
