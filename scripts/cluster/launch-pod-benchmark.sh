@@ -19,5 +19,19 @@ FABRIC="${6:?}"
 
 command -v gcloud >/dev/null || { echo "gcloud CLI required" >&2; exit 1; }
 
+# Env forwarding — the `mpirun -x FOO` / `-genv` role
+# (run-tf-sing-ucx-openmpi.sh:104-106): ship the head node's tuning env to
+# every worker, and have each worker source the setenv registry
+# (register_env.sh) before launching, restoring the host/container setenv
+# symmetry of the reference (its launchers source /mnt/shared/setenv and
+# forward HOROVOD_*/OMP_* through MPI).
+FWD=""
+for var in XLA_FLAGS LIBTPU_INIT_ARGS JAX_PLATFORMS TPU_HC_BENCH_SETENV \
+           JAX_TRACEBACK_FILTERING; do
+    if [ -n "${!var:-}" ]; then
+        FWD+="export $var=$(printf '%q' "${!var}"); "
+    fi
+done
+
 gcloud compute tpus tpu-vm ssh "$POD" --zone="$ZONE" --worker=all \
-    --command="cd tpu-hc-bench && ./scripts/run-tpu-ici.sh $NUM_HOSTS $WORKERS $BATCH $FABRIC"
+    --command="$FWD source \${TPU_HC_BENCH_SETENV:-\$HOME/.tpu_hc_bench/setenv} 2>/dev/null; cd tpu-hc-bench && ./scripts/run-tpu-ici.sh $NUM_HOSTS $WORKERS $BATCH $FABRIC"

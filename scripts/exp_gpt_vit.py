@@ -1,0 +1,104 @@
+"""gpt2 / ViT MFU ablations (round 2, VERDICT weak #6).
+
+Round-1 sweep: gpt2 dense 26.1% / flash 38.6%, vit_b16 31.3% — ~15 MFU
+points below same-math siblings (bert_base 46.0%, llama_1b 51.4%).  This
+harness isolates where the time goes by ablation on the real chip:
+attention impl, fused xent, remat, batch size, forward-only split.
+
+Usage: python scripts/exp_gpt_vit.py [exp ...]
+  exps: gpt2_flash gpt2_dense gpt2_fwd gpt2_xent gpt2_remat
+        vit64 vit128 vit256 vit128_remat bert_base llama_1b
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from tpu_hc_bench import flags
+from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
+from tpu_hc_bench.models import create_model
+from tpu_hc_bench.train import step as step_mod
+from tpu_hc_bench.topology import build_mesh, discover_layout
+
+PEAK = 197e12
+WARMUP, TIMED = 8, 20
+
+
+def bench(name, model_name, batch, *, attention_impl="dense",
+          fused_xent=False, remat=False, forward_only=False, seq_len=None):
+    cfg = flags.BenchmarkConfig(
+        model=model_name, batch_size=batch, attention_impl=attention_impl,
+        fused_xent=fused_xent, gradient_checkpointing=remat,
+        forward_only=forward_only, seq_len=seq_len,
+    ).resolve()
+    layout = discover_layout()
+    mesh = build_mesh(layout)
+    model, spec = create_model(
+        model_name, dtype=jnp.bfloat16, attention_impl=cfg.attention_impl,
+        seq_len=seq_len, gradient_checkpointing=remat)
+    if spec.is_text:
+        raw = SyntheticTokens(batch, spec.input_shape[0],
+                              vocab_size=spec.vocab_size,
+                              causal_lm=spec.causal_lm).batch()
+    else:
+        raw = SyntheticImages(batch, spec.input_shape).batch()
+    state = step_mod.make_train_state(model, cfg, raw)
+    state = step_mod.replicate_state(state, mesh)
+    train_step = step_mod.build_train_step(mesh, cfg, spec)
+    dev_batch = step_mod.shard_batch(raw, mesh)
+    rng = jax.random.PRNGKey(0)
+    for _ in range(WARMUP):
+        state, metrics = train_step(state, dev_batch, rng)
+    jax.device_get(metrics["loss"])     # tunnel-safe sync
+    t0 = time.perf_counter()
+    for _ in range(TIMED):
+        state, metrics = train_step(state, dev_batch, rng)
+    jax.device_get(metrics["loss"])
+    dt = (time.perf_counter() - t0) / TIMED
+    rate = batch / dt
+    mult = 1.0 if forward_only else 3.0
+    mfu = mult * spec.flops_per_example * rate / PEAK
+    print(f"{name:16s} {1e3 * dt:8.2f} ms  {rate:8.2f} ex/s  "
+          f"MFU {100 * mfu:5.1f}%", flush=True)
+
+
+EXPS = {
+    "gpt2_flash": lambda: bench("gpt2_flash", "gpt2", 8,
+                                attention_impl="flash"),
+    "gpt2_dense": lambda: bench("gpt2_dense", "gpt2", 8),
+    "gpt2_fwd": lambda: bench("gpt2_fwd", "gpt2", 8,
+                              attention_impl="flash", forward_only=True),
+    "gpt2_xent": lambda: bench("gpt2_xent", "gpt2", 8,
+                               attention_impl="flash", fused_xent=True),
+    "gpt2_remat": lambda: bench("gpt2_remat", "gpt2", 16,
+                                attention_impl="flash", remat=True),
+    "gpt2_bs16": lambda: bench("gpt2_bs16", "gpt2", 16,
+                               attention_impl="flash"),
+    "gpt2_bs32": lambda: bench("gpt2_bs32", "gpt2", 32,
+                               attention_impl="flash", remat=True),
+    "vit64": lambda: bench("vit64", "vit_b16", 64),
+    "vit128": lambda: bench("vit128", "vit_b16", 128),
+    "vit256": lambda: bench("vit256", "vit_b16", 256),
+    "vit128_remat": lambda: bench("vit128_remat", "vit_b16", 128,
+                                  remat=True),
+    "vit256_remat": lambda: bench("vit256_remat", "vit_b16", 256,
+                                  remat=True),
+    "vit128_fwd": lambda: bench("vit128_fwd", "vit_b16", 128,
+                                forward_only=True),
+}
+
+
+def main():
+    names = sys.argv[1:] or list(EXPS)
+    for n in names:
+        EXPS[n]()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""ResNet-50 MFU experiments (round 2): act on the round-1 profile.
+
+Round-1 diagnosis (BASELINE.md): stage-1 backward convs fused with BN-stat
+reductions run at ~43% internal MXU efficiency; resnet50_v2's preact order
+avoids the worst pattern (+13%).  This harness measures fusion-splitting
+variants of the v1 model on the real chip:
+
+  baseline      stock resnet50 (control)
+  barrier_pre   optimization_barrier between every conv output and its BN
+                (splits conv-bwd from BN-stat reductions in the transpose)
+  barrier_post  barrier after each BN+act (splits BN-apply from next conv)
+  barrier_both  both
+  v2            resnet50_v2 control (known +13%)
+
+Usage: python scripts/exp_resnet_mfu.py [variant ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, ".")
+
+from tpu_hc_bench import flags
+from tpu_hc_bench.models import create_model
+from tpu_hc_bench.models import resnet as resnet_mod
+from tpu_hc_bench.train import step as step_mod
+from tpu_hc_bench.topology import build_mesh, discover_layout
+
+BATCH = 128
+WARMUP = 12
+TIMED = 30
+FWD_FLOPS = 8.2e9          # models/__init__.py resnet50 spec
+PEAK = 197e12              # v5e bf16
+
+
+def make_step(model, spec):
+    cfg = flags.BenchmarkConfig(model="resnet50", batch_size=BATCH).resolve()
+    layout = discover_layout()
+    mesh = build_mesh(layout)
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, (BATCH, 224, 224, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, (BATCH,)).astype(np.int32)
+    batch = (images, labels)
+    state = step_mod.make_train_state(model, cfg, batch)
+    state = step_mod.replicate_state(state, mesh)
+    train_step = step_mod.build_train_step(mesh, cfg, spec)
+    dev_batch = step_mod.shard_batch(batch, mesh)
+    return state, train_step, dev_batch
+
+
+def bench(name, model, spec):
+    state, train_step, batch = make_step(model, spec)
+    rng = jax.random.PRNGKey(0)
+    for _ in range(WARMUP):
+        state, metrics = train_step(state, batch, rng)
+    # on the axon tunnel block_until_ready is advisory once the dispatch
+    # queue is deep — a value fetch is the only trustworthy sync
+    jax.device_get(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(TIMED):
+        state, metrics = train_step(state, batch, rng)
+    jax.device_get(metrics["loss"])
+    dt = (time.perf_counter() - t0) / TIMED
+    rate = BATCH / dt
+    mfu = 3 * FWD_FLOPS * rate / PEAK
+    print(f"{name:14s} {1e3 * dt:7.2f} ms/step  {rate:7.1f} img/s  "
+          f"MFU {100 * mfu:.1f}%", flush=True)
+    return rate
+
+
+def main():
+    variants = sys.argv[1:] or [
+        "baseline", "barrier_pre", "barrier_post", "barrier_both", "v2"]
+    dtype = jnp.bfloat16
+    for v in variants:
+        if v == "v2":
+            model, spec = create_model("resnet50_v2", dtype=dtype)
+        elif v == "baseline":
+            model, spec = create_model("resnet50", dtype=dtype)
+        else:
+            _, spec = create_model("resnet50", dtype=dtype)
+            model = resnet_mod.ResNet(
+                [3, 4, 6, 3], resnet_mod.BottleneckBlock, dtype=dtype,
+                barrier=v.removeprefix("barrier_"),
+            )
+        bench(v, model, spec)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,35 @@ import pytest
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# Persistent XLA executable cache: the suite's cost is dominated by
+# compiles of 8-device CPU programs, which are identical run to run —
+# a warm cache turns the ~20-min cold lane into a few minutes.
+jax.config.update("jax_compilation_cache_dir",
+                  "/tmp/tpu_hc_bench_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run tests marked slow (whole-model param counts, "
+             "multi-process launches) — the full lane, ~25 min")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight whole-model/multi-process test (runs only "
+        "with --runslow)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow test: pass --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

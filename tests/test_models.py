@@ -69,12 +69,14 @@ def test_resnet18_params():
     assert abs(count - 11.7e6) / 11.7e6 < 0.02, count
 
 
+@pytest.mark.slow
 def test_vgg16_params():
     model, spec, variables, x = init_model("vgg16")
     count = n_params(variables["params"])
     assert abs(count - 138.4e6) / 138.4e6 < 0.01, count
 
 
+@pytest.mark.slow
 def test_inception3_params_and_shape():
     model, spec, variables, x = init_model("inception3", image=96)
     count = n_params(variables["params"])
@@ -84,6 +86,7 @@ def test_inception3_params_and_shape():
     assert out.shape == (1, 1000)
 
 
+@pytest.mark.slow
 def test_alexnet_params_and_shape():
     model, spec, variables, x = init_model("alexnet")
     count = n_params(variables["params"])
@@ -93,6 +96,7 @@ def test_alexnet_params_and_shape():
     assert out.shape == (1, 1000)
 
 
+@pytest.mark.slow
 def test_googlenet_params_and_shape():
     model, spec, variables, x = init_model("googlenet", image=64)
     count = n_params(variables["params"])
@@ -121,12 +125,14 @@ def test_cifar_resnet_params():
         assert abs(count - want) / want < 0.03, (name, count)
 
 
+@pytest.mark.slow
 def test_vgg11_params():
     _, _, variables, _ = init_model("vgg11")
     count = n_params(variables["params"])
     assert abs(count - 132.9e6) / 132.9e6 < 0.01, count
 
 
+@pytest.mark.slow
 def test_inception4_params_and_shape():
     model, spec, variables, x = init_model("inception4", image=160)
     count = n_params(variables["params"])
@@ -145,6 +151,7 @@ def test_mobilenet_params_and_shape():
     assert out.shape == (1, 1000)
 
 
+@pytest.mark.slow
 def test_nasnet_mobile_params_and_shape():
     model, spec, variables, x = init_model("nasnet", image=96)
     count = n_params(variables["params"])
@@ -154,6 +161,7 @@ def test_nasnet_mobile_params_and_shape():
     assert out.shape == (1, 1000)
 
 
+@pytest.mark.slow
 def test_nasnetlarge_params():
     _, _, variables, _ = init_model("nasnetlarge", image=96)
     count = n_params(variables["params"])
@@ -161,6 +169,7 @@ def test_nasnetlarge_params():
     assert abs(count - 88.9e6) / 88.9e6 < 0.01, count
 
 
+@pytest.mark.slow
 def test_densenet40_params_and_shape():
     model, spec, variables, x = init_model("densenet40_k12", num_classes=10)
     count = n_params(variables["params"])
@@ -170,7 +179,11 @@ def test_densenet40_params_and_shape():
     assert out.shape == (1, 10)
 
 
-@pytest.mark.parametrize("name", ["lenet", "overfeat", "densenet100_k12"])
+@pytest.mark.parametrize("name", [
+    "lenet",
+    pytest.param("overfeat", marks=pytest.mark.slow),        # 231px init
+    pytest.param("densenet100_k12", marks=pytest.mark.slow), # 100-layer graph
+])
 def test_small_zoo_forward(name):
     model, spec, variables, x = init_model(
         name, num_classes=10 if "densenet" in name else 1000)
@@ -220,6 +233,7 @@ def test_resnet_s2d_forward():
         models.create_model("mobilenet", space_to_depth=True)
 
 
+@pytest.mark.slow
 def test_bert_base_params():
     model = bert.BertMLM()
     x = jnp.zeros((1, 128), jnp.int32)
@@ -231,6 +245,7 @@ def test_bert_base_params():
     assert out.shape == (1, 128, bert.BERT_BASE_VOCAB)
 
 
+@pytest.mark.slow
 def test_bert_large_params():
     model = bert.bert_large_mlm()
     x = jnp.zeros((1, 16), jnp.int32)
@@ -273,6 +288,7 @@ def test_bf16_compute_keeps_fp32_params_and_logits():
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_gpt2_params_and_causality():
     from tpu_hc_bench.models import gpt
 
@@ -335,6 +351,7 @@ def test_gradient_checkpointing_matches():
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_vit_b16_params():
     from tpu_hc_bench.models import vit
 
@@ -376,6 +393,7 @@ def test_vit_remat_accepted():
     assert model.apply(variables, x, train=False).shape == (1, 10)
 
 
+@pytest.mark.slow
 def test_vit_l16_params():
     from tpu_hc_bench.models import vit
 

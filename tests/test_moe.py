@@ -159,9 +159,54 @@ def test_ragged_backward_and_no_drops():
         assert float(jnp.abs(leaf).max()) > 0.0
 
 
+def test_ragged_chunked_matches_unchunked():
+    """The chunked grouped-matmul path (round 2: bounded VMEM via lax.map
+    over sorted chunks) is bitwise-equivalent routing to the one-shot
+    ragged_dot — only the matmul tiling differs."""
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 32, 16))
+    kw = dict(hidden=16, ffn=32, num_experts=4, top_k=2, impl="ragged")
+    one_shot = MoEFFN(**kw, ragged_chunk=1 << 20)
+    chunked = MoEFFN(**kw, ragged_chunk=16)     # 2*32*2=128 pairs -> 8 chunks
+    params = one_shot.init(jax.random.PRNGKey(8), x)["params"]
+
+    def run(layer, p):
+        y, _ = layer.apply({"params": p}, x, mutable=["losses"])
+        return y
+
+    y1 = run(one_shot, params)
+    y2 = run(chunked, params)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-5, atol=1e-6)
+    # gradients flow through the chunked lax.map path too
+    g = jax.grad(lambda p: jnp.sum(run(chunked, p) ** 2))(params)
+    assert float(jnp.abs(g["wi"]).max()) > 0.0
+
+
+def test_capacity_factor_plumbs_through():
+    """--moe_capacity_factor reaches MoEFFN; lower factor drops tokens."""
+    model, _ = create_model("moe_tiny", moe_capacity_factor=0.5)
+    assert model.moe_capacity_factor == 0.5
+    with pytest.raises(ValueError, match="MoE members"):
+        create_model("gpt2", moe_capacity_factor=0.5)
+    # behavioral: capacity 0.5 drops tokens that capacity 2.0 keeps
+    x = jax.random.normal(jax.random.PRNGKey(9), (1, 32, 16))
+    tight = MoEFFN(hidden=16, ffn=32, num_experts=4, top_k=2,
+                   capacity_factor=0.25)
+    roomy = MoEFFN(hidden=16, ffn=32, num_experts=4, top_k=2,
+                   capacity_factor=2.0)
+    params = tight.init(jax.random.PRNGKey(10), x)["params"]
+    yt, _ = tight.apply({"params": params}, x, mutable=["losses"])
+    yr, _ = roomy.apply({"params": params}, x, mutable=["losses"])
+    assert not np.allclose(np.asarray(yt), np.asarray(yr))
+
+
 def test_moe_impl_flag_guards():
     with pytest.raises(ValueError, match="moe_impl=einsum"):
         flags.BenchmarkConfig(expert_parallel=2, moe_impl="ragged").resolve()
+    # capacity factor is an einsum-only concept: loud error, not silence
+    with pytest.raises(ValueError, match="einsum dispatch only"):
+        flags.BenchmarkConfig(moe_impl="ragged",
+                              moe_capacity_factor=0.5).resolve()
     # TP also shards the expert tensors (tp_param_spec moe/ rules)
     with pytest.raises(ValueError, match="moe_impl=einsum"):
         flags.BenchmarkConfig(model_parallel=2, moe_impl="ragged").resolve()

@@ -14,6 +14,10 @@ import sys
 import textwrap
 from pathlib import Path
 
+import pytest
+
+pytestmark = pytest.mark.slow      # spawns whole python processes
+
 REPO = Path(__file__).resolve().parent.parent
 
 WORKER = textwrap.dedent("""

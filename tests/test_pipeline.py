@@ -166,9 +166,15 @@ def test_pp_dropout_mode_trains(devices):
     assert float(jnp.abs(params["wte"]["embedding"]).sum()) != before
 
 
-def test_pp_flag_exclusivity():
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        flags.BenchmarkConfig(pipeline_parallel=2, model_parallel=2).resolve()
-    with pytest.raises(ValueError, match="combined"):
-        build_mesh(compute_layout(1, 8, 8), model_parallel=2,
-                   pipeline_parallel=2)
+def test_pp_flag_composition():
+    # round 2: PP x TP is a supported hybrid (resolves + builds a 3-D
+    # mesh); PP x SP remains rejected
+    cfg = flags.BenchmarkConfig(pipeline_parallel=2, model_parallel=2
+                                ).resolve()
+    assert cfg.pipeline_parallel == 2 and cfg.model_parallel == 2
+    mesh = build_mesh(compute_layout(1, 8, 8), model_parallel=2,
+                      pipeline_parallel=2)
+    assert len(mesh.axis_names) == 3
+    with pytest.raises(ValueError, match="not a supported composition"):
+        flags.BenchmarkConfig(pipeline_parallel=2,
+                              sequence_parallel=2).resolve()

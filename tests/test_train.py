@@ -253,7 +253,7 @@ def test_sp_flag_translation_and_guards():
     assert cfg.attention_impl == "ulysses_flash"
     with pytest.raises(ValueError, match="sequence_parallel"):
         flags.BenchmarkConfig(attention_impl="ring").resolve()
-    with pytest.raises(ValueError, match="mutually exclusive"):
+    with pytest.raises(ValueError, match="not a supported composition"):
         flags.BenchmarkConfig(sequence_parallel=2,
                               pipeline_parallel=2).resolve()
 

@@ -8,18 +8,26 @@ standard ilsvrc2012 TFRecord schema tf_cnn_benchmarks consumes), and each
 data-parallel worker reads its own slice of the shard list — the per-rank
 sharding Horovod ranks do (SURVEY.md §3.1 "input: ... shard by rank").
 
-TPU-first decisions: decode/resize happen on host CPU in a double-buffered
-background thread (prefetch), delivering ready NHWC float32 batches so the
-device never waits on JPEG decode; training-time augmentation is the
-benchmark-standard random-resized-crop + horizontal flip.
+TPU-first decisions: decode/resize happen on host CPU in a *parallel decode
+pool* behind a double-buffered background thread (prefetch), delivering
+ready NHWC batches so the device never waits on JPEG decode; training-time
+augmentation is the benchmark-standard random-resized-crop + horizontal
+flip.  The pool is a ThreadPoolExecutor — the native libjpeg decoder
+(`native/jpeg_decoder.cpp`) runs outside the GIL (ctypes releases it for
+the C call), so threads scale to real decode parallelism without the
+fork/pickle cost of multiprocessing.  Each image's augmentation RNG is
+seeded by its global stream index, so the pixel stream is deterministic
+per seed and independent of pool size.
 """
 
 from __future__ import annotations
 
 import glob
 import io
+import os
 import queue
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Iterator
 
@@ -157,6 +165,7 @@ class ImageNetDataset:
         prefetch: int = 2,
         labels_zero_based: bool = False,
         wire_dtype: str = "float32",
+        decode_workers: int | None = None,
     ):
         if wire_dtype not in ("float32", "uint8"):
             raise ValueError(f"wire_dtype must be float32|uint8: {wire_dtype}")
@@ -172,6 +181,11 @@ class ImageNetDataset:
         # "uint8" ships raw crops (4x less host->device traffic; the MXU-
         # feeding normalize runs on device — see driver.device_normalize)
         self.wire_dtype = wire_dtype
+        # decode pool width (tf_cnn_benchmarks --datasets_num_private_threads
+        # analog); None = auto-size to the host's cores, 0/1 = serial
+        if decode_workers is None:
+            decode_workers = max(1, min(32, (os.cpu_count() or 2) - 1))
+        self.decode_workers = decode_workers
 
     @staticmethod
     def _read_shard(path: str) -> Iterator[bytes]:
@@ -203,34 +217,71 @@ class ImageNetDataset:
             epoch += 1
 
     def _batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        rng = np.random.default_rng(self.seed)
         stream = self._example_stream()
         s = self.image_size
         normalize = self.wire_dtype == "float32"
         dtype = np.float32 if normalize else np.uint8
-        while True:
-            images = np.empty((self.global_batch, s, s, 3), dtype)
-            labels = np.empty((self.global_batch,), np.int32)
-            for i in range(self.global_batch):
-                jpeg, label = next(stream)
-                images[i] = _decode_and_crop(jpeg, s, rng, self.train,
-                                             normalize=normalize)
-                labels[i] = label
-            yield images, labels
+
+        def decode_into(images, labels, i, jpeg, label, stream_idx):
+            # per-image rng: deterministic for (seed, position-in-stream)
+            # regardless of decode order / pool width
+            rng = np.random.default_rng((self.seed, stream_idx))
+            images[i] = _decode_and_crop(jpeg, s, rng, self.train,
+                                         normalize=normalize)
+            labels[i] = label
+
+        pool = (ThreadPoolExecutor(self.decode_workers)
+                if self.decode_workers > 1 else None)
+        stream_idx = 0
+        try:
+            while True:
+                images = np.empty((self.global_batch, s, s, 3), dtype)
+                labels = np.empty((self.global_batch,), np.int32)
+                items = []
+                for i in range(self.global_batch):
+                    jpeg, label = next(stream)
+                    items.append((i, jpeg, label, stream_idx))
+                    stream_idx += 1
+                if pool is None:
+                    for it in items:
+                        decode_into(images, labels, *it)
+                else:
+                    futs = [pool.submit(decode_into, images, labels, *it)
+                            for it in items]
+                    for f in futs:
+                        f.result()   # re-raises decode errors here
+                yield images, labels
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         """Prefetching iterator: decode runs in a daemon thread."""
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
+        def put(item) -> bool:
+            # bounded put that notices consumer abandonment: a plain
+            # q.put would block forever once the consumer stops draining,
+            # pinning the generator frame and leaking the decode pool
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def producer():
+            gen = self._batches()
             try:
-                for batch in self._batches():
-                    if stop.is_set():
+                for batch in gen:
+                    if not put(batch):
                         return
-                    q.put(batch)
             except Exception as e:  # surface decode errors to the consumer
-                q.put(e)
+                put(e)
+            finally:
+                gen.close()        # runs _batches' finally -> pool.shutdown
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

@@ -107,6 +107,9 @@ class BenchmarkConfig:
     num_inter_threads: int = 2
     kmp_blocktime: int = 1
     kmp_affinity: str = "granularity=fine,noverbose,compact,1,0"
+    # tf_cnn_benchmarks' input-pipeline private threadpool — here it is the
+    # REAL width of the host JPEG decode pool (data/imagenet.py); 0 = auto
+    datasets_num_private_threads: int = 0
 
     # --- TPU-native additions (no reference analog) ---
     fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
@@ -160,6 +163,18 @@ class BenchmarkConfig:
                                               # (einsum = GShard GSPMD/EP;
                                               # ragged = grouped-matmul
                                               # ragged_dot fast DP path)
+    moe_capacity_factor: float = 1.25         # einsum slots/expert =
+                                              # ceil(cf*k*S/E): the
+                                              # token-drop pressure valve
+                                              # for long-context MoE
+    train_dir: str | None = None              # tf_cnn_benchmarks --train_dir:
+                                              # save checkpoints here during
+                                              # training; --eval restores the
+                                              # latest from it
+    save_model_steps: int = 0                 # save every N timed steps
+                                              # (0 = final state only; the
+                                              # steps analog of tf_cnn's
+                                              # --save_model_secs)
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -203,13 +218,18 @@ class BenchmarkConfig:
                 "--model_parallel and --expert_parallel are exclusive: both "
                 "shard over the mesh 'model' axis"
             )
-        if sum(d > 1 for d in (self.pipeline_parallel, self.model_parallel,
-                               self.expert_parallel,
-                               self.sequence_parallel)) > 1:
+        # round 2: minor axes compose — supported hybrids are DPxPPxTP and
+        # DPxSPxTP (model auto/GSPMD under a manual PP/SP shard_map); the
+        # remaining pairings are rejected here and in run_benchmark
+        if self.pipeline_parallel > 1 and self.sequence_parallel > 1:
             raise ValueError(
-                "--model_parallel/--expert_parallel/--pipeline_parallel/"
-                "--sequence_parallel are mutually exclusive (one minor "
-                "mesh axis)"
+                "--pipeline_parallel x --sequence_parallel is not a "
+                "supported composition (supported: DPxPPxTP, DPxSPxTP)"
+            )
+        if self.expert_parallel > 1 and (self.pipeline_parallel > 1
+                                         or self.sequence_parallel > 1):
+            raise ValueError(
+                "--expert_parallel composes with data parallelism only"
             )
         if self.sequence_parallel > 1:
             if self.variable_update == "replicated":
@@ -236,6 +256,12 @@ class BenchmarkConfig:
                 f"--attention_impl={self.attention_impl} requires "
                 f"--sequence_parallel > 1 (it attends across seq shards)"
             )
+        if self.moe_impl == "ragged" and self.moe_capacity_factor != 1.25:
+            raise ValueError(
+                "--moe_capacity_factor applies to the einsum dispatch only: "
+                "the ragged grouped-matmul path has no capacity concept "
+                "(zero token drops), so the flag would be silently ignored"
+            )
         if self.moe_impl == "ragged" and (
                 self.expert_parallel > 1 or self.model_parallel > 1):
             # TP also shards the expert tensors over the model axis
@@ -257,7 +283,12 @@ class BenchmarkConfig:
             prior = t.get("variable_update")
             t["variable_update"] = f"{prior}; {note}" if prior else note
         sharded = max(self.model_parallel, self.expert_parallel)
-        if sharded > 1 and self.variable_update != "replicated":
+        # ...but NOT under the SP (or PP) hybrids: there the manual
+        # shard_map step keeps running and the model axis rides auto/GSPMD
+        # inside it, so variable_update stays on the psum path
+        if (sharded > 1 and self.variable_update != "replicated"
+                and self.sequence_parallel == 1
+                and self.pipeline_parallel == 1):
             which = ("model_parallel" if self.model_parallel > 1
                      else "expert_parallel")
             t["variable_update"] = (
@@ -330,6 +361,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_inter_threads", type=int, default=d.num_inter_threads)
     p.add_argument("--kmp_blocktime", type=int, default=d.kmp_blocktime)
     p.add_argument("--kmp_affinity", type=str, default=d.kmp_affinity)
+    p.add_argument("--datasets_num_private_threads", type=int,
+                   default=d.datasets_num_private_threads)
+    p.add_argument("--train_dir", type=str, default=None)
+    p.add_argument("--save_model_steps", type=int, default=d.save_model_steps)
+    p.add_argument("--moe_capacity_factor", type=float,
+                   default=d.moe_capacity_factor)
     p.add_argument("--fusion_threshold_bytes", type=int,
                    default=d.fusion_threshold_bytes)
     p.add_argument("--seed", type=int, default=d.seed)

@@ -184,13 +184,18 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                  attention_impl: str = "dense", space_to_depth: bool = False,
                  seq_len: int | None = None,
                  gradient_checkpointing: bool = False,
-                 moe_impl: str = "einsum", seq_axis: str | None = None):
+                 moe_impl: str = "einsum", seq_axis: str | None = None,
+                 moe_capacity_factor: float = 1.25):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
     if spec.moe:
         kwargs["moe_impl"] = moe_impl
+        kwargs["moe_capacity_factor"] = moe_capacity_factor
     elif moe_impl != "einsum":
         raise ValueError(f"--moe_impl only applies to MoE members, not {name}")
+    elif moe_capacity_factor != 1.25:
+        raise ValueError(
+            f"--moe_capacity_factor only applies to MoE members, not {name}")
     if seq_axis is not None and not spec.is_text:
         raise ValueError(f"--sequence_parallel only applies to text models, "
                          f"not {name}")

@@ -24,6 +24,11 @@ from tpu_hc_bench.models.bert import MultiHeadAttention, global_position_ids
 
 GPT2_VOCAB = 50257
 GPT2_CTX = 1024
+# Dropout rates shared with the pipeline-parallel re-implementation of the
+# forward (parallel/pipeline.py builds the GPTLM math from DecoderLayer +
+# these constants — change them here and both paths move together).
+EMBED_DROPOUT = 0.1
+RESID_DROPOUT = 0.1
 
 
 class DecoderLayer(nn.Module):
@@ -43,6 +48,7 @@ class DecoderLayer(nn.Module):
     num_experts: int = 0
     top_k: int = 2
     moe_impl: str = "einsum"
+    moe_capacity_factor: float = 1.25
     causal: bool = True                # ViT reuses this block bidirectional
 
     @nn.compact
@@ -53,19 +59,21 @@ class DecoderLayer(nn.Module):
             attention_impl=self.attention_impl, seq_axis=self.seq_axis,
             causal=self.causal,
         )(h)
-        x = x + nn.Dropout(0.1, deterministic=not train)(h)
+        x = x + nn.Dropout(RESID_DROPOUT, deterministic=not train)(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         if self.num_experts:
             from tpu_hc_bench.models.moe import MoEFFN
 
             h = MoEFFN(self.hidden, self.ffn, self.num_experts,
                        top_k=self.top_k, dtype=self.dtype,
-                       impl=self.moe_impl, name="moe")(h)
+                       impl=self.moe_impl,
+                       capacity_factor=self.moe_capacity_factor,
+                       name="moe")(h)
         else:
             h = nn.Dense(self.ffn, dtype=self.dtype, name="fc")(h)
             h = nn.gelu(h)
             h = nn.Dense(self.hidden, dtype=self.dtype, name="proj")(h)
-        return x + nn.Dropout(0.1, deterministic=not train)(h)
+        return x + nn.Dropout(RESID_DROPOUT, deterministic=not train)(h)
 
 
 class GPTLM(nn.Module):
@@ -82,6 +90,7 @@ class GPTLM(nn.Module):
     num_experts: int = 0               # >0: MoE FFNs (models/moe.py)
     top_k: int = 2
     moe_impl: str = "einsum"           # einsum (GSPMD/EP) | ragged (fast DP)
+    moe_capacity_factor: float = 1.25  # einsum slots/expert multiplier
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True):
@@ -92,7 +101,7 @@ class GPTLM(nn.Module):
         x = embed(token_ids) + nn.Embed(
             self.max_len, self.hidden, dtype=self.dtype, name="wpe"
         )(pos_ids[None, :])
-        x = nn.Dropout(0.1, deterministic=not train)(x)
+        x = nn.Dropout(EMBED_DROPOUT, deterministic=not train)(x)
         # static_argnums counts bound-method args with self=0:
         # (self, x, train) -> train is static
         layer_cls = (nn.remat(DecoderLayer, static_argnums=(2,))
@@ -102,7 +111,9 @@ class GPTLM(nn.Module):
                 self.hidden, self.heads, self.ffn, dtype=self.dtype,
                 attention_impl=self.attention_impl, seq_axis=self.seq_axis,
                 num_experts=self.num_experts, top_k=self.top_k,
-                moe_impl=self.moe_impl, name=f"layer_{i}",
+                moe_impl=self.moe_impl,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name=f"layer_{i}",
             )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         # tied output projection: operands in compute dtype, f32
@@ -139,7 +150,8 @@ def gpt2_medium(num_classes: int = 0, dtype=jnp.float32,
 def gpt2_moe(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
              remat: bool = False, moe_impl: str = "einsum",
-             seq_axis: str | None = None):
+             seq_axis: str | None = None,
+             moe_capacity_factor: float = 1.25):
     """GPT-2-small trunk with 8-expert top-2 MoE FFNs (~520M params,
     ~180M active per token: the 124M dense trunk swaps its 57M of FFNs
     for 2x-of-8 expert FFNs) — the expert-parallel workload."""
@@ -147,17 +159,20 @@ def gpt2_moe(num_classes: int = 0, dtype=jnp.float32,
     return GPTLM(dtype=dtype, attention_impl=attention_impl,
                  max_len=max(GPT2_CTX, max_len or 0), remat=remat,
                  num_experts=8, top_k=2, moe_impl=moe_impl,
+                 moe_capacity_factor=moe_capacity_factor,
                  seq_axis=seq_axis)
 
 
 def moe_tiny(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
              remat: bool = False, moe_impl: str = "einsum",
-             seq_axis: str | None = None):
+             seq_axis: str | None = None,
+             moe_capacity_factor: float = 1.25):
     """4-layer/128-hidden 4-expert decoder for tests and CPU smoke runs."""
     del num_classes
     return GPTLM(vocab_size=1024, hidden=128, num_layers=4, heads=4,
                  ffn=256, dtype=dtype, attention_impl=attention_impl,
                  max_len=max(128, max_len or 0), remat=remat,
                  num_experts=4, top_k=2, moe_impl=moe_impl,
+                 moe_capacity_factor=moe_capacity_factor,
                  seq_axis=seq_axis)

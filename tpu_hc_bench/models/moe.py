@@ -116,9 +116,17 @@ class MoEFFN(nn.Module):
     ffn: int
     num_experts: int
     top_k: int = 2
-    capacity_factor: float = 1.25
+    capacity_factor: float = 1.25      # einsum path: slots per expert =
+                                       # ceil(cf * k * S / E); lower = more
+                                       # drops, less dispatch memory — the
+                                       # long-context pressure valve
     dtype: Any = jnp.float32
     impl: str = "einsum"
+    ragged_chunk: int = 8192           # ragged path: max token-pair rows
+                                       # per grouped matmul; larger inputs
+                                       # run as a lax.map over chunks so
+                                       # Mosaic's scoped-VMEM tiling never
+                                       # sees an oversized operand
 
     @nn.compact
     def __call__(self, x):
@@ -176,11 +184,39 @@ class MoEFFN(nn.Module):
         pair_expert = jnp.stack(choices, 1).reshape(n * k)
         pair_token = jnp.repeat(jnp.arange(n), k)
         order = jnp.argsort(pair_expert)
-        group_sizes = jnp.bincount(pair_expert, length=e).astype(jnp.int32)
         xs = flat[pair_token[order]]                      # [N*k, H]
-        h1 = nn.gelu(jax.lax.ragged_dot(xs, wi.astype(self.dtype),
-                                        group_sizes))
-        out = jax.lax.ragged_dot(h1, wo.astype(self.dtype), group_sizes)
+        wi_c, wo_c = wi.astype(self.dtype), wo.astype(self.dtype)
+
+        total = n * k
+        if total <= self.ragged_chunk:
+            group_sizes = jnp.bincount(pair_expert, length=e).astype(
+                jnp.int32)
+            h1 = nn.gelu(jax.lax.ragged_dot(xs, wi_c, group_sizes))
+            out = jax.lax.ragged_dot(h1, wo_c, group_sizes)
+        else:
+            # chunked grouped matmuls (round 2): big batchxseq blew past
+            # Mosaic's scoped-VMEM tiling limit (BASELINE.md r1: 19.4M >
+            # 16M at bs=16/seq=1024).  A contiguous slice of the sorted
+            # pair array is still expert-sorted, so each chunk is a valid
+            # ragged_dot with its own histogram; padding rows are tagged
+            # with the last expert (keeps sortedness) and dropped after.
+            chunk = self.ragged_chunk
+            pad = (-total) % chunk
+            seg = jnp.concatenate(
+                [pair_expert[order],
+                 jnp.full((pad,), e - 1, pair_expert.dtype)])
+            xs_p = jnp.pad(xs, ((0, pad), (0, 0)))
+            chunks = (total + pad) // chunk
+            seg_c = seg.reshape(chunks, chunk)
+            sizes = jax.nn.one_hot(seg_c, e, dtype=jnp.int32).sum(1)
+
+            def body(args):
+                xc, sz = args
+                h1 = nn.gelu(jax.lax.ragged_dot(xc, wi_c, sz))
+                return jax.lax.ragged_dot(h1, wo_c, sz)
+
+            out = jax.lax.map(body, (xs_p.reshape(chunks, chunk, h), sizes))
+            out = out.reshape(chunks * chunk, h)[:total]
         # inverse-permute back to token-major pair order; weighted sum
         # over each token's k picks (pure gathers, no scatter)
         inv = jnp.argsort(order)

@@ -20,6 +20,7 @@ import functools
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
@@ -124,6 +125,11 @@ class ResNet(nn.Module):
                                         # run the stem as a 4x4/s1 conv — the
                                         # standard TPU stem transform (3-ch
                                         # 7x7/s2 convs map poorly to the MXU)
+    barrier: str = "none"               # fusion-split experiment knob
+                                        # (scripts/exp_resnet_mfu.py):
+                                        # pre  = barrier conv-out -> BN-in
+                                        # post = barrier BN-out -> act/conv
+                                        # both = both edges
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -137,6 +143,20 @@ class ResNet(nn.Module):
             epsilon=1e-5,
             dtype=self.dtype,
         )
+        def barriered(factory):
+            # factory -> factory whose modules emit through an
+            # optimization_barrier, splitting the fusion at that edge
+            # (e.g. conv-backward from the BN-stat reductions XLA would
+            # fuse into it — the round-1 ~43%-MXU-efficiency pattern)
+            def make(*a, **k):
+                m = factory(*a, **k)
+                return lambda y: jax.lax.optimization_barrier(m(y))
+            return make
+
+        if self.barrier in ("pre", "both"):
+            conv = barriered(conv)
+        if self.barrier in ("post", "both"):
+            norm = barriered(norm)
         act = nn.relu
 
         x = x.astype(self.dtype)

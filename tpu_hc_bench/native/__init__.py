@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import ctypes
 import subprocess
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -121,11 +122,25 @@ def read_records_native(path: str | Path, verify: bool = True):
 _JPEG_PATH = _DIR / "libthb_jpeg.so"
 _jpeg_lib = None
 _jpeg_failed = False
+_jpeg_lock = threading.Lock()
 
 
 def _load_jpeg() -> ctypes.CDLL | None:
     global _jpeg_lib, _jpeg_failed
     if _jpeg_lib is not None:
+        return _jpeg_lib
+    if _jpeg_failed:
+        return None
+    with _jpeg_lock:
+        return _load_jpeg_locked()
+
+
+def _load_jpeg_locked() -> ctypes.CDLL | None:
+    """Build+dlopen under _jpeg_lock: the decode pool's first batch hits
+    this from many threads at once, and a concurrent double-`make` could
+    dlopen a half-written .so and latch _jpeg_failed permanently."""
+    global _jpeg_lib, _jpeg_failed
+    if _jpeg_lib is not None:        # raced: another thread finished first
         return _jpeg_lib
     if _jpeg_failed:
         return None

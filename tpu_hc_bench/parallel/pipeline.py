@@ -171,14 +171,33 @@ def train_state_from_pp(params: dict, opt_state, template, num_layers: int):
     return template.replace(params=p, opt_state=opt)
 
 
-def pp_param_specs(params: dict) -> dict:
-    """trunk shards its leading (layer) dim over pipe; the rest replicates."""
-    return {
-        k: jax.tree.map(
-            lambda x: P(PIPE_AXIS, *(None,) * (x.ndim - 1)) if k == "trunk"
-            else P(), v)
-        for k, v in params.items()
-    }
+def pp_param_specs(params: dict, tp: bool = False) -> dict:
+    """trunk shards its leading (layer) dim over pipe; the rest replicates.
+
+    ``tp=True`` (DP x PP x TP hybrid) additionally shards each stacked
+    layer tensor's feature dims over the model axis per the Megatron
+    ``tp_param_spec`` rules (applied to the within-layer path, skipping
+    the leading stacked-layer dim).  These full specs are for *placement*;
+    the pipeline's partial-manual shard_map uses the pipe-only variant as
+    ``in_specs`` and the model axis stays auto (GSPMD).
+    """
+    from tpu_hc_bench.train.step import tp_param_spec
+
+    def trunk_leaf(path, x):
+        inner: tuple = ()
+        if tp:
+            name = "/".join(getattr(k, "key", str(k)) for k in path)
+            inner = tuple(tp_param_spec(name, x.ndim - 1))
+        pad = (None,) * (x.ndim - 1 - len(inner))
+        return P(PIPE_AXIS, *inner, *pad)
+
+    out = {}
+    for k, v in params.items():
+        if k == "trunk":
+            out[k] = jax.tree_util.tree_map_with_path(trunk_leaf, v)
+        else:
+            out[k] = jax.tree.map(lambda x: P(), v)
+    return out
 
 
 def _opt_specs(opt_state, param_specs: dict, params: dict):
@@ -190,7 +209,7 @@ def _opt_specs(opt_state, param_specs: dict, params: dict):
 
 def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
                         example_params: dict, example_opt_state,
-                        deterministic: bool = False):
+                        deterministic: bool = False, tp: bool = False):
     """DP x PP training step for the GPT decoder family.
 
     ``model`` is a ``GPTLM`` whose params have been restacked with
@@ -214,6 +233,7 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
     layer = DecoderLayer(model.hidden, model.heads, model.ffn,
                          dtype=model.dtype, num_experts=model.num_experts,
                          top_k=model.top_k, moe_impl=model.moe_impl,
+                         moe_capacity_factor=model.moe_capacity_factor,
                          attention_impl=model.attention_impl)
     ln_f = nn.LayerNorm(dtype=model.dtype)
     tx = make_optimizer(cfg)
@@ -238,10 +258,11 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
         x = (wte.astype(model.dtype)[tokens]
              + wpe.astype(model.dtype)[jnp.arange(s)][None])
         if rng is not None:
-            # GPTLM's post-embedding dropout; the 0.1 rate mirrors the
-            # hardcoded rates in models/gpt.py and must track them
+            # GPTLM's post-embedding dropout, at the shared rate constant
+            from tpu_hc_bench.models.gpt import EMBED_DROPOUT
+
             rng, ekey = jax.random.split(rng)
-            x = nn.Dropout(0.1, deterministic=False).apply(
+            x = nn.Dropout(EMBED_DROPOUT, deterministic=False).apply(
                 {}, x, rngs={"dropout": ekey})
         mb = b // num_microbatches
         xs = x.reshape(num_microbatches, mb, s, model.hidden)
@@ -308,28 +329,50 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    # shard_map specs carry the MANUAL axes only (data, pipe); under the
+    # DPxPPxTP hybrid the model axis stays auto — the arrays arrive
+    # model-sharded (place_pp_state tp=True) and GSPMD partitions the
+    # per-stage layer math, inserting the Megatron all-reduces
     pspecs = pp_param_specs(example_params)
     ospecs = _opt_specs(example_opt_state, pspecs, example_params)
+    manual: dict = {}
+    if tp:
+        manual = {"axis_names": frozenset({DATA_AXIS, PIPE_AXIS})}
     shard_fn = jax.shard_map(
         device_step, mesh=mesh,
         in_specs=(pspecs, ospecs, P(DATA_AXIS), P()),
         out_specs=(pspecs, ospecs, P()),
         check_vma=False,
+        **manual,
     )
     jitted = jax.jit(shard_fn, donate_argnums=(0, 1))
 
     def step(params, opt_state, batch, rng=None):
         if rng is None:
-            # fixed-key fallback: fine for deterministic mode (ignored) and
-            # one-off dryruns; per-step training should pass a fresh key
-            # (the driver folds its step counter in)
-            rng = jax.random.PRNGKey(0)
+            if not deterministic:
+                raise ValueError(
+                    "pipeline step with dropout active (deterministic="
+                    "False) requires a per-step rng key — a silent fixed "
+                    "key would reuse identical dropout masks every step"
+                )
+            rng = jax.random.PRNGKey(0)   # ignored under deterministic
         return jitted(params, opt_state, batch, rng)
 
     return step, tx
 
 
-def make_pp_state(model, cfg, example_tokens, mesh: Mesh):
+def place_pp_state(params: dict, opt_state, mesh: Mesh, tp: bool = False):
+    """Place a PP ``(params, opt_state)`` on the mesh: trunk sharded over
+    the pipe axis (and, with ``tp``, feature dims over the model axis),
+    everything else replicated."""
+    pspecs = pp_param_specs(params, tp=tp)
+    ospecs = _opt_specs(opt_state, pspecs, params)
+    put = lambda tree, specs: jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
+    return put(params, pspecs), put(opt_state, ospecs)
+
+
+def make_pp_state(model, cfg, example_tokens, mesh: Mesh, tp: bool = False):
     """Init GPTLM params, restack layers for the pipe axis, init SGD.
 
     Returns ``(params, opt_state)`` placed on the mesh (trunk sharded over
@@ -346,8 +389,4 @@ def make_pp_state(model, cfg, example_tokens, mesh: Mesh):
     params = stack_layer_params(variables["params"], model.num_layers)
     tx = make_optimizer(cfg)
     opt_state = tx.init(params)
-    pspecs = pp_param_specs(params)
-    ospecs = _opt_specs(opt_state, pspecs, params)
-    put = lambda tree, specs: jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
-    return put(params, pspecs), put(opt_state, ospecs)
+    return place_pp_state(params, opt_state, mesh, tp=tp)

@@ -148,14 +148,19 @@ def build_mesh(
 ) -> Mesh:
     """Build the device mesh for this layout.
 
-    DP-only (reference parity) gives a 1-D ``("data",)`` mesh.  Passing
-    ``model_parallel > 1`` folds the trailing chips of each host into a
-    ``("data", "model")`` mesh (tensor/expert parallelism);
-    ``pipeline_parallel > 1`` a ``("data", "pipe")`` mesh — so the same
-    builder serves hybrid sharding without changing callers (SURVEY.md
-    §2c implication).  The minor axis gets adjacent chips: TP/EP/PP
-    collectives (all-reduce, all-to-all, stage ppermute hops) ride
-    neighbor ICI links.
+    DP-only (reference parity) gives a ``("data", "model")`` mesh with a
+    size-1 model axis.  Minor degrees > 1 append their axes; *multiple*
+    minor degrees compose into a hybrid 3-D (or 4-D) mesh — e.g.
+    ``pipeline_parallel=2, model_parallel=2`` on 8 devices yields a
+    ``(data=2, pipe=2, model=2)`` mesh (round-2: the one-minor-axis
+    restriction is lifted; DPxPPxTP and DPxSPxTP are the supported hybrid
+    step compositions, see train/step.py and parallel/pipeline.py).
+
+    Axis order = collective frequency: ``model`` innermost (an all-reduce
+    per layer rides adjacent-chip ICI), then ``seq`` (per-attention
+    ppermute ring), then ``pipe`` (one hop per microbatch tick), ``data``
+    outermost (one gradient reduction per step, the only axis that may
+    cross hosts/DCN).
 
     Device order: host-major, chip-minor — the data axis crosses hosts last,
     so intra-host ICI carries the short allreduce hops and DCN only the
@@ -164,22 +169,23 @@ def build_mesh(
     """
     import numpy as np
 
-    if sum(d > 1 for d in
-           (model_parallel, pipeline_parallel, sequence_parallel)) > 1:
-        raise ValueError(
-            "model/pipeline/sequence parallel degrees cannot be combined "
-            "on the 2-D mesh (pick one minor axis)")
-    minor = max(model_parallel, pipeline_parallel, sequence_parallel)
-    if pipeline_parallel > 1:
-        minor_name = PIPE_AXIS
-    elif sequence_parallel > 1:
-        minor_name = SEQ_AXIS
-    else:
-        minor_name = MODEL_AXIS
+    minors = [(PIPE_AXIS, pipeline_parallel), (SEQ_AXIS, sequence_parallel),
+              (MODEL_AXIS, model_parallel)]
+    for name, deg in minors:
+        if deg < 1:
+            raise ValueError(f"{name} degree must be >= 1, got {deg}")
+    active = [(name, deg) for name, deg in minors if deg > 1]
     picked = select_devices(layout, devices)
     n = len(picked)
-    if n % minor:
+    prod = 1
+    for _, deg in active:
+        prod *= deg
+    if n % prod:
         raise ValueError(
-            f"{n} devices not divisible by {minor_name}_parallel={minor}")
-    arr = np.array(picked, dtype=object).reshape(n // minor, minor)
-    return Mesh(arr, (DATA_AXIS, minor_name))
+            f"{n} devices not divisible by the minor-axis product "
+            f"{prod} ({'x'.join(f'{nm}={d}' for nm, d in active)})")
+    if not active:
+        active = [(MODEL_AXIS, 1)]      # preserve the 2-D DP mesh shape
+    shape = (n // prod,) + tuple(deg for _, deg in active)
+    arr = np.array(picked, dtype=object).reshape(shape)
+    return Mesh(arr, (DATA_AXIS,) + tuple(name for name, _ in active))

@@ -229,6 +229,46 @@ class _AsyncTimeline:
         return total_time, window_times
 
 
+def _maybe_restore(state, cfg, print_fn):
+    """--train_dir resume: restore the latest checkpoint if one exists.
+
+    Returns ``(state, restored?)``; the caller re-places the state on the
+    mesh (restore yields host arrays).
+    """
+    if not cfg.train_dir:
+        return state, False
+    from tpu_hc_bench.utils import checkpoint as ckpt
+
+    if ckpt.latest_step(cfg.train_dir) is None:
+        return state, False
+    state = ckpt.restore(state, cfg.train_dir)
+    print_fn(f"restored checkpoint step "
+             f"{int(jax.device_get(state.step))} from {cfg.train_dir}")
+    return state, True
+
+
+def _save_state(state, cfg, print_fn, pp_ctx=None):
+    """Save to --train_dir (process 0 only).  ``state`` is a TrainState, or
+    the PP ``(params, opt_state)`` tuple when ``pp_ctx=(model, template)``
+    — the DP<->DPxPP checkpoint interchange: PP runs restack into the DP
+    layout so the checkpoint restores under either strategy."""
+    if not cfg.train_dir or jax.process_index() != 0:
+        return
+    from tpu_hc_bench.utils import checkpoint as ckpt
+
+    if pp_ctx is not None:
+        from tpu_hc_bench.parallel import pipeline as pipe_mod
+
+        model, template, steps_done = pp_ctx
+        params, opt_state = state
+        state = pipe_mod.train_state_from_pp(
+            params, opt_state, template, model.num_layers)
+        state = state.replace(
+            step=jax.numpy.asarray(steps_done, jax.numpy.int32))
+    path = ckpt.save(state, cfg.train_dir)
+    print_fn(f"checkpoint saved: {path}")
+
+
 def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
               fab, print_fn):
     """tf_cnn_benchmarks --eval: timed forward passes + top-1 accuracy."""
@@ -294,17 +334,30 @@ def run_benchmark(
 
     fab = fabric_mod.resolve_fabric(fabric_name)
     layout = layout or discover_layout()
-    # model_parallel (TP), expert_parallel (EP), pipeline_parallel (PP),
-    # and sequence_parallel (SP) all claim the mesh's minor axis;
-    # resolve() enforces their mutual exclusivity
+    # TP/EP claim the mesh's "model" axis, PP "pipe", SP "seq".  Round 2:
+    # minor axes COMPOSE — DPxPPxTP and DPxSPxTP are the supported 3-D
+    # hybrids (PP/SP manual shard_map axes, model auto/GSPMD); the other
+    # pairings are rejected explicitly.
     pp = max(1, getattr(cfg, "pipeline_parallel", 1))
     sp = max(1, getattr(cfg, "sequence_parallel", 1))
-    mp = max(1, cfg.model_parallel, getattr(cfg, "expert_parallel", 1),
-             pp, sp)
+    tp = max(1, cfg.model_parallel)
+    ep = max(1, getattr(cfg, "expert_parallel", 1))
+    if tp > 1 and ep > 1:
+        raise ValueError(
+            "--model_parallel and --expert_parallel share the mesh's "
+            "model axis; pick one")
+    if pp > 1 and sp > 1:
+        raise ValueError(
+            "--pipeline_parallel x --sequence_parallel is not a supported "
+            "composition (supported hybrids: DPxPPxTP, DPxSPxTP)")
+    if ep > 1 and (pp > 1 or sp > 1):
+        raise ValueError(
+            "--expert_parallel composes with data parallelism only")
+    mp = max(tp, ep) * pp * sp      # minor product = DP-degree divisor
     if layout.total_workers % mp:
         raise ValueError(
             f"--model_parallel/--expert_parallel/--pipeline_parallel/"
-            f"--sequence_parallel={mp} does not divide "
+            f"--sequence_parallel product {mp} does not divide "
             f"{layout.total_workers} workers"
         )
     if mp > 1 and fab is fabric_mod.Fabric.HOST:
@@ -313,11 +366,10 @@ def run_benchmark(
             "--sequence_parallel requires a device fabric (ici/dcn): the "
             "host path's shard_map would silently re-replicate the shards"
         )
-    mesh = build_mesh(layout,
-                      model_parallel=mp if pp == 1 and sp == 1 else 1,
+    mesh = build_mesh(layout, model_parallel=max(tp, ep),
                       pipeline_parallel=pp, sequence_parallel=sp)
     # with TP/EP/PP/SP, the data-parallel degree (and so the global batch
-    # at fixed per-worker batch) shrinks by the minor-axis degree
+    # at fixed per-worker batch) shrinks by the minor-axis product
     global_batch = layout.global_batch(cfg.batch_size) // mp
 
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
@@ -327,6 +379,8 @@ def run_benchmark(
                                seq_len=cfg.seq_len,
                                gradient_checkpointing=cfg.gradient_checkpointing,
                                moe_impl=getattr(cfg, "moe_impl", "einsum"),
+                               moe_capacity_factor=getattr(
+                                   cfg, "moe_capacity_factor", 1.25),
                                seq_axis=SEQ_AXIS if sp > 1 else None)
     if sp > 1:
         seq_len = spec.input_shape[0]
@@ -346,6 +400,8 @@ def run_benchmark(
     fcfg = fabric_mod.FabricConfig(fab, cfg.fusion_threshold_bytes)
     print_fn(fcfg.summary())
     print_fn(f"device_kind={hw.device_kind()} global_batch={global_batch}")
+    for line in hw.ici_topology_lines():
+        print_fn(line)
 
     # --- data ---
     if cfg.data_dir is not None and not spec.is_text:
@@ -376,6 +432,8 @@ def run_benchmark(
             # uint8 ships 4x less host->device traffic; the cast+normalize
             # runs inside the compiled step (train.step.prep_inputs)
             wire_dtype=cfg.wire_dtype,
+            # 0 = auto-size the decode pool to the host's cores
+            decode_workers=cfg.datasets_num_private_threads or None,
         )
         host_iter = iter(ds)
         batch = next(host_iter)
@@ -415,6 +473,7 @@ def run_benchmark(
                 yield dev_batch
 
     # --- state + step ---
+    pp_save_ctx = None     # (model, template) when PP saves need restacking
     if sp > 1:
         print_fn(f"sequence parallel: {sp} shards x "
                  f"{spec.input_shape[0] // sp} tokens/shard "
@@ -425,7 +484,14 @@ def run_benchmark(
         init_model = model.clone(attention_impl="dense", seq_axis=None)
         state = step_mod.make_train_state(init_model, cfg, batch)
         state = state.replace(apply_fn=model.apply)
-        state = step_mod.replicate_state(state, mesh)
+        state, _ = _maybe_restore(state, cfg, print_fn)
+        if tp > 1:
+            # DP x SP x TP: params/opt model-sharded (auto axis), the SP
+            # step's shard_map stays manual over data+seq only
+            print_fn(f"tensor parallel: {tp}-way (hybrid with SP)")
+            state = step_mod.shard_state_tp(state, mesh)
+        else:
+            state = step_mod.replicate_state(state, mesh)
         # the shared psum step builder handles SP (axes = (data, seq),
         # fusion buckets reduce over both)
         train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
@@ -433,10 +499,15 @@ def run_benchmark(
     elif pp > 1:
         if cfg.eval:
             raise ValueError("--eval with --pipeline_parallel is not supported")
-        if not spec.causal_lm:
+        from tpu_hc_bench.models.gpt import GPTLM
+
+        # build_pp_train_step reconstructs the GPT forward (wte/wpe/
+        # DecoderLayer trunk), so llama etc. must be rejected here even
+        # though they are causal LMs too
+        if not isinstance(model, GPTLM):
             raise ValueError(
                 "--pipeline_parallel currently supports the GPT decoder "
-                f"family (causal LM), not {cfg.model}")
+                f"family (GPTLM), not {cfg.model}")
         from tpu_hc_bench.parallel import pipeline as pipe_mod
 
         if model.num_layers % pp:
@@ -451,9 +522,29 @@ def run_benchmark(
                 f"num_microbatches={num_mb}")
         print_fn(f"pipeline: {pp} stages x {num_mb} microbatches "
                  f"({model.num_layers // pp} layers/stage)")
-        params, opt_state = pipe_mod.make_pp_state(model, cfg, batch[0], mesh)
+        if tp > 1:
+            print_fn(f"tensor parallel: {tp}-way (hybrid with PP)")
+        pp_base_step = 0
+        restored = False
+        if cfg.train_dir:
+            # DP<->DPxPP checkpoint interchange: restore the DP-layout
+            # checkpoint through a host-side abstract template (no device
+            # memory — PP models may not fit one device), restack the
+            # layer subtrees into the pipe-sharded trunk, re-place
+            pp_template = step_mod.abstract_train_state(model, cfg, batch)
+            restored_t, restored = _maybe_restore(pp_template, cfg, print_fn)
+            if restored:
+                pp_base_step = int(np.asarray(restored_t.step))
+                params, opt_state = pipe_mod.pp_state_from_train_state(
+                    restored_t, model.num_layers)
+                params, opt_state = pipe_mod.place_pp_state(
+                    params, opt_state, mesh, tp=tp > 1)
+            pp_save_ctx = (model, pp_template, pp_base_step)
+        if not restored:
+            params, opt_state = pipe_mod.make_pp_state(model, cfg, batch[0],
+                                                       mesh, tp=tp > 1)
         pp_step, _ = pipe_mod.build_pp_train_step(
-            mesh, model, cfg, num_mb, params, opt_state)
+            mesh, model, cfg, num_mb, params, opt_state, tp=tp > 1)
         state = (params, opt_state)
 
         def train_step(state, batch, rng):
@@ -463,6 +554,15 @@ def run_benchmark(
         batch_iter = batches()
     else:
         state = step_mod.make_train_state(model, cfg, batch)
+        state, restored = _maybe_restore(state, cfg, print_fn)
+        if cfg.eval and not restored:
+            if cfg.train_dir:
+                raise FileNotFoundError(
+                    f"--eval: no checkpoint found under {cfg.train_dir}")
+            print_fn(
+                "WARNING: --eval without --train_dir measures RANDOMLY "
+                "INITIALIZED params — the accuracy line is meaningless; "
+                "train with --train_dir first and pass it here")
         if mp > 1:
             mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
             state = step_mod.shard_state_tp(state, mesh, mode)
@@ -512,10 +612,24 @@ def run_benchmark(
                               global_batch)
     timeline.start(metrics["loss"])
     warmup_steps = max(1, cfg.num_warmup_batches)
+    def save_now(i: int) -> None:
+        ctx = None
+        if pp_save_ctx is not None:
+            pp_model, pp_template, pp_base = pp_save_ctx
+            # resume-aware stamp: continue the restored checkpoint's step
+            # count so a resumed PP run never saves under a lower step
+            ctx = (pp_model, pp_template, pp_base + warmup_steps + i)
+        _save_state(state, cfg, print_fn, pp_ctx=ctx)
+
     for i in range(1, cfg.num_batches + 1):
         state, metrics = train_step(state, next(batch_iter),
                                     jax.random.fold_in(rng, warmup_steps + i))
         timeline.record(i, metrics["loss"])
+        if (cfg.train_dir and cfg.save_model_steps
+                and i % cfg.save_model_steps == 0 and i < cfg.num_batches):
+            # NOTE: saving fetches the full state — it syncs the device and
+            # perturbs the throughput measurement around this step
+            save_now(i)
         if tracing and timeline.fetcher.fetched_step >= timeline.sync_every:
             jax.profiler.stop_trace()
             tracing = False
@@ -531,6 +645,8 @@ def run_benchmark(
     if tracing:
         jax.profiler.stop_trace()
         print_fn(f"profiler trace written to {cfg.trace_dir}")
+    if cfg.train_dir:
+        save_now(cfg.num_batches)       # final state (tf_cnn train_dir)
     total_rate = cfg.num_batches * global_batch / total_time
     per_chip = total_rate / layout.total_workers
     mean_ms = 1e3 * total_time / cfg.num_batches

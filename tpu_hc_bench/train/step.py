@@ -92,6 +92,39 @@ def make_train_state(
     )
 
 
+def abstract_train_state(
+    model, cfg: BenchmarkConfig, example_batch: tuple
+) -> TrainState:
+    """Host-side zero-filled TrainState — a checkpoint template.
+
+    Same tree structure/dtypes as ``make_train_state`` but built from
+    ``jax.eval_shape``, so it allocates NO device memory (host zeros are
+    copy-on-write pages).  Used where a template must coexist with a
+    sharded model that may not fit one device (the PP checkpoint
+    interchange).
+    """
+    rng = jax.random.PRNGKey(cfg.seed)
+    inputs = np.asarray(example_batch[0])
+    shapes = jax.eval_shape(
+        functools.partial(model.init, train=False),
+        {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
+        jax.ShapeDtypeStruct(inputs[:1].shape, inputs.dtype),
+    )
+    tx = make_optimizer(cfg)
+    params_s = shapes["params"]
+    opt_s = jax.eval_shape(tx.init, params_s)
+    zeros = lambda tree: jax.tree.map(
+        lambda s: np.zeros(s.shape, s.dtype), tree)
+    return TrainState(
+        step=np.zeros((), np.int32),
+        params=zeros(params_s),
+        batch_stats=zeros(shapes.get("batch_stats", {})),
+        opt_state=zeros(opt_s),
+        apply_fn=model.apply,
+        tx=tx,
+    )
+
+
 def prep_inputs(inputs):
     """uint8 wire format -> normalized float32, inside the compiled step.
 
@@ -164,24 +197,34 @@ def build_train_step(
     """
     is_text = spec.is_text
     fuse = cfg.variable_update == "psum"
+    sp = getattr(cfg, "sequence_parallel", 1) > 1
+    tp = getattr(cfg, "model_parallel", 1) > 1
 
     if fab is fabric_mod.Fabric.HOST:
         return _build_host_step(mesh, cfg, is_text)
-    if (getattr(cfg, "model_parallel", 1) > 1
-            or getattr(cfg, "expert_parallel", 1) > 1):
+    if not sp and (tp or getattr(cfg, "expert_parallel", 1) > 1):
         # TP/EP run on the GSPMD arm: params enter committed with
         # tp_param_spec shardings and jit follows them
         return _build_gspmd_step(mesh, cfg, is_text, follow_inputs=True)
-    if cfg.variable_update == "replicated":
+    if not sp and cfg.variable_update == "replicated":
         return _build_gspmd_step(mesh, cfg, is_text)
 
     # --sequence_parallel: same explicit-psum step over a (data, seq) mesh
     # — batch sharded over both axes, gradients reduced (with the same
-    # fusion buckets) over both; the model was built seq-axis-aware
+    # fusion buckets) over both; the model was built seq-axis-aware.
+    # DP x SP x TP (3-D hybrid): data/seq stay *manual* shard_map axes
+    # (the ring/Ulysses attention's explicit ppermutes need them) while the
+    # model axis stays *auto* — params enter model-sharded per
+    # tp_param_spec and GSPMD partitions the matmuls inside the manual
+    # body, inserting the Megatron all-reduces itself.
     from tpu_hc_bench.topology import SEQ_AXIS
 
-    sp = getattr(cfg, "sequence_parallel", 1) > 1
     axes = (DATA_AXIS, SEQ_AXIS) if sp else (DATA_AXIS,)
+    if sp and tp:
+        # fusion buckets concatenate grad tensors, which would force
+        # all-gathers of the model-sharded grads under the auto axis —
+        # reduce per-tensor instead
+        fuse = False
 
     def device_step(state: TrainState, batch, dropout_rng):
         # per-device: local shard of the batch, replicated state
@@ -234,12 +277,17 @@ def build_train_step(
 
     replicated = P()
     sharded = P(*axes)
+    manual: dict = {}
+    if sp and tp:
+        # partial-manual shard_map: data/seq manual, model auto (GSPMD)
+        manual = {"axis_names": frozenset(axes)}
     shard_fn = jax.shard_map(
         device_step,
         mesh=mesh,
         in_specs=(replicated, sharded, replicated),
         out_specs=(replicated, replicated),
         check_vma=False,
+        **manual,
     )
     jitted = jax.jit(shard_fn, donate_argnums=(0,))
 
@@ -410,8 +458,13 @@ def tp_param_spec(path: str, ndim: int, mode: str = "tp") -> P:
     (and everything unmatched) replicate, so the rules are safe to apply to
     any model in the zoo.
 
-    Matches both naming schemes: BERT's anonymous FFN denses
-    (``Dense_0``/``Dense_1``) and GPT's ``fc``/``proj``.
+    Matches all three naming schemes in the zoo: BERT's anonymous FFN
+    denses (``Dense_0``/``Dense_1``), GPT's ``fc``/``proj``, and llama's
+    ``wq``/``wk``/``wv``/``wo`` attention + ``gate``/``up``/``down``
+    SwiGLU projections (Q/K/V and FFN-in column-parallel, out-proj and
+    FFN-down row-parallel; GQA KV heads shard like Q heads, so the TP
+    degree must divide ``num_kv_heads`` — ``jax.device_put`` rejects the
+    uneven case loudly).
 
     ``mode="ep"`` (``--expert_parallel``) restricts the rules to the MoE
     expert tensors: whole experts shard over the model axis, the dense
@@ -430,6 +483,16 @@ def tp_param_spec(path: str, ndim: int, mode: str = "tp") -> P:
         ("fc/kernel", P(None, M)),
         ("fc/bias", P(M)),
         ("proj/kernel", P(M, None)),
+        # llama family (models/llama.py): DenseGeneral QKV kernels are
+        # [C, heads, head_dim] (kv: [C, kv_heads, head_dim]); wo is
+        # [heads, head_dim, C]; SwiGLU gate/up [C, ffn], down [ffn, C]
+        ("wq/kernel", P(None, M, None)),
+        ("wk/kernel", P(None, M, None)),
+        ("wv/kernel", P(None, M, None)),
+        ("wo/kernel", P(M, None, None)),
+        ("gate/kernel", P(None, M)),
+        ("up/kernel", P(None, M)),
+        ("down/kernel", P(M, None)),
         # expert parallelism: whole experts live on model-axis shards
         # (models/moe.py wi [E, H, F] / wo [E, F, H]); GSPMD turns the
         # [E]-sharded dispatch/combine einsums into expert all-to-alls
@@ -465,13 +528,20 @@ def shard_state_tp(state: TrainState, mesh: Mesh,
     DP x EP (``mode="ep"``).
     """
     specs = _param_specs(state.params, mode)
-    if mode == "ep" and not any(
+    if not any(
         s != P() for s in jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
     ):
+        if mode == "ep":
+            raise ValueError(
+                "expert_parallel > 1 but no param matched an expert rule: "
+                "the model has no MoE layers (use an moe member, e.g. "
+                "gpt2_moe), so EP would only halve the data-parallel degree"
+            )
         raise ValueError(
-            "expert_parallel > 1 but no param matched an expert rule: the "
-            "model has no MoE layers (use an moe member, e.g. gpt2_moe), "
-            "so EP would only halve the data-parallel degree"
+            "model_parallel > 1 but no param matched a tensor-parallel "
+            "rule: this model's param names have no TP layout (only the "
+            "transformer families do), so TP would silently replicate "
+            "every param and degrade to DP with a smaller global batch"
         )
 
     def put(spec_tree, tree):

@@ -40,3 +40,40 @@ def peak_flops(device: jax.Device | None = None, dtype: str = "bfloat16") -> flo
 
 def device_kind() -> str:
     return jax.devices()[0].device_kind
+
+
+def ici_topology_lines(devices=None) -> list[str]:
+    """Live fabric introspection for the banner — the operator's ground
+    truth before a run, playing the role of the reference's sysfs PKEY
+    read + UCX_NET_DEVICES pin (run-tf-sing-ucx-openmpi.sh:85-95).
+
+    Reports the slice shape (chip-coordinate bounding box), per-host chip
+    counts, and each local chip's ICI coordinates.  Degrades gracefully on
+    devices without coords (CPU test meshes): reports kinds only.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    lines = []
+    coords = [getattr(d, "coords", None) for d in devices]
+    if any(c is not None for c in coords):
+        known = [c for c in coords if c is not None]
+        dims = range(len(known[0]))
+        shape = "x".join(
+            str(max(c[i] for c in known) - min(c[i] for c in known) + 1)
+            for i in dims)
+        lines.append(
+            f"ici: slice_shape={shape} chips={len(known)} "
+            f"kind={devices[0].device_kind}")
+        per_host: dict[int, list] = {}
+        for d, c in zip(devices, coords):
+            per_host.setdefault(d.process_index, []).append(
+                (d.id, c, getattr(d, "core_on_chip", 0)))
+        for host in sorted(per_host):
+            chips = " ".join(
+                f"d{did}@{','.join(map(str, c))}" if c is not None
+                else f"d{did}" for did, c, _ in per_host[host])
+            lines.append(f"ici: host{host}: {chips}")
+    else:
+        lines.append(
+            f"ici: no chip coordinates exposed ({devices[0].device_kind} "
+            f"x{len(devices)}) — virtual/CPU mesh")
+    return lines
