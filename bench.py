@@ -40,6 +40,12 @@ def main() -> int:
         num_warmup_batches=int(os.environ.get("BENCH_WARMUP", "50")),
         num_batches=int(os.environ.get("BENCH_BATCHES", "100")),
         display_every=10,
+        # packed 4x4/s1 stem — same math as the 7x7/s2 conv (proven by
+        # tests/test_models.py::test_space_to_depth_stem_equivalence).
+        # Default OFF: the round-2 A/B measured s2d slower (BASELINE.md
+        # "space_to_depth re-measured").  Models without an s2d stem are
+        # rejected loudly by create_model.
+        use_space_to_depth=os.environ.get("BENCH_S2D", "0") == "1",
     ).resolve()
 
     # human-readable progress to stderr; stdout carries only the JSON line

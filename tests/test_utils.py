@@ -73,3 +73,25 @@ def test_peak_flops_table():
     # CPU test devices fall into the nominal row
     assert hw.peak_flops(dtype="bfloat16") > 0
     assert hw.peak_flops(dtype="float32") > 0
+
+
+def test_ici_topology_lines():
+    # CPU mesh: no coords -> graceful virtual-mesh line
+    lines = hw.ici_topology_lines()
+    assert lines and lines[0].startswith("ici:")
+    assert "virtual/CPU mesh" in lines[0]
+
+    # TPU-shaped fakes: coords -> slice shape + per-host chip map
+    class FakeDev:
+        def __init__(self, i, coords):
+            self.id = i
+            self.coords = coords
+            self.process_index = 0
+            self.core_on_chip = 0
+            self.device_kind = "TPU v5 lite"
+
+    devs = [FakeDev(i, (i % 2, i // 2, 0)) for i in range(4)]
+    lines = hw.ici_topology_lines(devs)
+    assert "slice_shape=2x2x1" in lines[0]
+    assert "chips=4" in lines[0]
+    assert "d0@0,0,0" in lines[1]
