@@ -95,6 +95,46 @@ PP_WORKER = textwrap.dedent("""
 """)
 
 
+TP_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from tpu_hc_bench.parallel import distributed
+    from tpu_hc_bench import topology
+
+    port = int(sys.argv[1])
+    distributed.initialize(coordinator_port=port)
+    assert jax.process_count() == 2 and jax.device_count() == 4
+
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.data.synthetic import SyntheticTokens
+    from tpu_hc_bench.models import create_model
+    from tpu_hc_bench.train import step as step_mod
+
+    layout = topology.discover_layout(workers_per_host=0)
+    # model axis = adjacent chips (intra-process Megatron all-reduces);
+    # the data-axis gradient psum crosses the process boundary (DCN analog)
+    mesh = topology.build_mesh(layout, model_parallel=2)
+    cfg = flags.BenchmarkConfig(model="bert_tiny", batch_size=1,
+                                model_parallel=2).resolve()
+    model, spec = create_model("bert_tiny")
+    raw = SyntheticTokens(2, 32, vocab_size=1024, seed=0).batch()
+    state = step_mod.make_train_state(model, cfg, raw)
+    state = step_mod.shard_state_tp(state, mesh)
+    qkv = state.params["layer_0"]["MultiHeadAttention_0"]["qkv"]["kernel"]
+    assert topology.MODEL_AXIS in qkv.sharding.spec
+    train_step = step_mod.build_train_step(mesh, cfg, spec)
+    state, metrics = train_step(state, step_mod.shard_batch(raw, mesh),
+                                jax.random.PRNGKey(0))
+    loss = float(jax.device_get(metrics["loss"]))
+    assert loss == loss, "tp loss is NaN"
+    print(f"MP_TP_OK process={jax.process_index()} loss={loss:.4f}",
+          flush=True)
+""")
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -160,3 +200,10 @@ def test_two_process_pipeline_step(tmp_path):
     """DP x PP across 2 processes: pipe hops intra-process, the data-axis
     gradient psum crosses the process boundary (the DCN analog)."""
     _run_two_workers(tmp_path, PP_WORKER, "MP_PP_OK")
+
+
+def test_two_process_tensor_parallel_step(tmp_path):
+    """DP x TP across 2 processes: Megatron all-reduces intra-process on
+    the model axis, the gradient reduction crossing the process boundary —
+    multi-host tensor parallelism end to end."""
+    _run_two_workers(tmp_path, TP_WORKER, "MP_TP_OK")

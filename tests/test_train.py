@@ -258,6 +258,25 @@ def test_sp_flag_translation_and_guards():
                               pipeline_parallel=2).resolve()
 
 
+def test_num_epochs_duration(mesh8):
+    """tf_cnn's --num_epochs: duration derived from dataset size and the
+    resolved global batch (48 examples / gb 16 -> 3 timed steps)."""
+    cfg = flags.BenchmarkConfig(
+        batch_size=2, num_warmup_batches=1, display_every=2,
+        model="trivial", num_classes=10, num_epochs=48 / 1_281_167,
+    ).resolve()
+    out = []
+    driver.run_benchmark(cfg, print_fn=out.append)
+    text = "\n".join(out)
+    assert "-> num_batches=3" in text
+    assert cfg.num_epochs == 0.0          # cleared: cfg re-resolvable
+    cfg.resolve()                          # does not raise
+
+    # an EXPLICIT --num_batches conflicts even at the default value
+    with pytest.raises(ValueError, match="cannot both be set"):
+        flags.BenchmarkConfig(num_batches=100, num_epochs=1.0).resolve()
+
+
 def test_log_name_convention():
     # reference: tfmn-<n>n-<b>b-<data>-<fabric>-r<run>.log (:9-12)
     assert driver.log_name(4, 64, "synthetic", "ici", 1) == \

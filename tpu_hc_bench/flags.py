@@ -73,7 +73,14 @@ class BenchmarkConfig:
     # --- core experiment knobs (reference :32-35, :62-66) ---
     batch_size: int = 64                      # per-worker batch (README.md:70)
     num_warmup_batches: int = DEFAULT_WARMUP_BATCHES
-    num_batches: int = DEFAULT_NUM_BATCHES
+    # None = unset (resolve() fills DEFAULT_NUM_BATCHES) so an explicit
+    # --num_batches=100 still conflicts with --num_epochs
+    num_batches: int | None = None
+    num_epochs: float = 0.0                   # tf_cnn_benchmarks --num_epochs:
+                                              # when set, num_batches is
+                                              # derived from the dataset size
+                                              # and the resolved global batch
+                                              # (driver, needs the layout)
     model: str = DEFAULT_MODEL
     display_every: int = DEFAULT_DISPLAY_EVERY
     optimizer: str = "momentum"               # --optimizer=momentum (:74)
@@ -213,6 +220,15 @@ class BenchmarkConfig:
             t["thread_tuning"] = (
                 "num_intra/inter_threads,kmp_* parsed but no-op on TPU"
             )
+        if self.num_epochs and self.num_batches is not None:
+            # tf_cnn_benchmarks semantics: the two duration flags conflict
+            raise ValueError(
+                "--num_batches and --num_epochs cannot both be set"
+            )
+        if self.num_epochs < 0:
+            raise ValueError(f"--num_epochs must be >= 0: {self.num_epochs}")
+        if self.num_batches is None and not self.num_epochs:
+            self.num_batches = DEFAULT_NUM_BATCHES
         if self.model_parallel > 1 and self.expert_parallel > 1:
             raise ValueError(
                 "--model_parallel and --expert_parallel are exclusive: both "
@@ -335,7 +351,8 @@ def build_parser() -> argparse.ArgumentParser:
     d = BenchmarkConfig()
     p.add_argument("--batch_size", type=int, default=d.batch_size)
     p.add_argument("--num_warmup_batches", type=int, default=d.num_warmup_batches)
-    p.add_argument("--num_batches", type=int, default=d.num_batches)
+    p.add_argument("--num_batches", type=int, default=None)
+    p.add_argument("--num_epochs", type=float, default=d.num_epochs)
     p.add_argument("--model", type=str, default=d.model)
     p.add_argument("--display_every", type=int, default=d.display_every)
     p.add_argument("--optimizer", type=str, default=d.optimizer,

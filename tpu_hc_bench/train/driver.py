@@ -372,6 +372,21 @@ def run_benchmark(
     # at fixed per-worker batch) shrinks by the minor-axis product
     global_batch = layout.global_batch(cfg.batch_size) // mp
 
+    if cfg.num_epochs:
+        # tf_cnn_benchmarks --num_epochs: duration in dataset passes,
+        # resolvable only here (needs the global batch); eval epochs run
+        # over the validation split's size.  num_epochs is cleared after
+        # derivation so the cfg stays re-resolvable.
+        import math
+
+        examples = 50_000 if cfg.eval else 1_281_167   # ilsvrc2012 splits
+        cfg.num_batches = math.ceil(
+            cfg.num_epochs * examples / global_batch)
+        print_fn(f"num_epochs={cfg.num_epochs} -> "
+                 f"num_batches={cfg.num_batches} "
+                 f"(global_batch={global_batch})")
+        cfg.num_epochs = 0.0
+
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, attention_impl=cfg.attention_impl,
