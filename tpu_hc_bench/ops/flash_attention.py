@@ -31,12 +31,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Default blocks: big tiles amortize per-tile grid/DMA overhead, which
-# dominates this kernel on v5e (measured fwd+bwd @ seq 4096, d 64:
-# 21.6 ms at 256x512 -> 18.5 ms at 1024x1024).  Working set at d=64 is
-# ~9 MB of VMEM (f32 score+prob tiles dominate, 4 MB each) — inside the
-# ~16 MB budget; callers with head_dim > 128 get block_k halved below.
-# Overridable per call for small test shapes.
+# Default blocks: 1024x1024, confirmed by a round-2 back-to-back A/B
+# inside the FULL gpt2 train step (162.0 ms vs 175.8 ms for 512x512 at
+# seq 1024 bs 16 — +8.5%).  NOTE the *isolated-kernel* microbench says
+# the opposite (512x512 wins by 10-13% when the attention grad runs
+# alone): in context the rest of the layer competes for VMEM and the
+# scheduler hides the big tiles' latency, so only whole-model A/Bs are
+# trusted for this knob.  Working set at d=64 is ~9 MB of VMEM (f32
+# score/prob tiles dominate); callers with head_dim > 128 get block_k
+# halved below.  Overridable per call for small test shapes.
 _BLOCK_Q = 1024
 _BLOCK_K = 1024
 _NEG_INF = -1e30
