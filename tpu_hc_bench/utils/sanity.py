@@ -57,6 +57,16 @@ def collect_report() -> tuple[list[str], list[str]]:
         failures.append(f"device discovery failed: {e}")
         return lines, failures
 
+    # live fabric introspection (the ibv_devinfo / PKEY-read analog) —
+    # informational: a failure here must not abort the report or flip the
+    # exit code the setup scripts gate on
+    try:
+        from tpu_hc_bench.utils import hw
+
+        lines.extend(hw.ici_topology_lines(devs))
+    except Exception as e:
+        lines.append(f"ici: topology introspection unavailable ({e})")
+
     # compiled-matmul smoke test: the IsMklEnabled() analog — proves the
     # XLA backend compiles and executes on the accelerator
     try:
