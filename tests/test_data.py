@@ -22,6 +22,16 @@ def test_record_roundtrip(tmp_path):
     assert back == records
 
 
+def test_count_records(tmp_path):
+    path = tmp_path / "n.tfrecord"
+    tfrecord.write_records(path, [b"a", b"bb" * 500, b""])
+    assert tfrecord.count_records(path) == 3
+    # count_examples sums over a split's shards
+    imagenet.make_synthetic_shards(
+        tmp_path / "ds", num_shards=3, examples_per_shard=5, image_size=16)
+    assert imagenet.count_examples(tmp_path / "ds") == 15
+
+
 def test_corrupt_crc_detected(tmp_path):
     path = tmp_path / "bad.tfrecord"
     tfrecord.write_records(path, [b"payload"])

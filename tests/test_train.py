@@ -258,19 +258,34 @@ def test_sp_flag_translation_and_guards():
                               pipeline_parallel=2).resolve()
 
 
-def test_num_epochs_duration(mesh8):
-    """tf_cnn's --num_epochs: duration derived from dataset size and the
-    resolved global batch (48 examples / gb 16 -> 3 timed steps)."""
+def test_num_epochs_duration(mesh8, tmp_path):
+    """tf_cnn's --num_epochs: duration derived from the ACTUAL dataset's
+    example count and the resolved global batch (2x16=32 examples / gb 16
+    -> 2 timed steps per epoch, x1.5 epochs -> 3)."""
+    from tpu_hc_bench.data import imagenet
+
+    imagenet.make_synthetic_shards(
+        tmp_path, num_shards=2, examples_per_shard=16, image_size=32,
+        num_classes=10,
+    )
     cfg = flags.BenchmarkConfig(
         batch_size=2, num_warmup_batches=1, display_every=2,
-        model="trivial", num_classes=10, num_epochs=48 / 1_281_167,
+        model="trivial", num_classes=10, num_epochs=1.5,
+        data_dir=str(tmp_path),
     ).resolve()
     out = []
     driver.run_benchmark(cfg, print_fn=out.append)
     text = "\n".join(out)
-    assert "-> num_batches=3" in text
+    assert "(32 examples) -> num_batches=3" in text
     assert cfg.num_epochs == 0.0          # cleared: cfg re-resolvable
     cfg.resolve()                          # does not raise
+
+    # synthetic/text streams have no epoch size: reject, don't assume
+    cfg2 = flags.BenchmarkConfig(
+        batch_size=2, model="trivial", num_classes=10, num_epochs=1.0,
+    ).resolve()
+    with pytest.raises(ValueError, match="real image dataset"):
+        driver.run_benchmark(cfg2, print_fn=lambda _: None)
 
     # an EXPLICIT --num_batches conflicts even at the default value
     with pytest.raises(ValueError, match="cannot both be set"):

@@ -51,6 +51,13 @@ def find_shards(data_dir: str | Path, split: str = "train") -> list[str]:
     raise FileNotFoundError(f"no {split} TFRecord shards under {data_dir}")
 
 
+def count_examples(data_dir: str | Path, split: str = "train") -> int:
+    """Total example count across ALL of a split's shards (the epoch size
+    for --num_epochs — the per-worker shard split jointly covers the full
+    dataset once per epoch)."""
+    return sum(tfrecord.count_records(s) for s in find_shards(data_dir, split))
+
+
 def shards_for_worker(
     shards: list[str], worker: int, num_workers: int
 ) -> list[str]:
@@ -182,8 +189,9 @@ class ImageNetDataset:
         # feeding normalize runs on device — see driver.device_normalize)
         self.wire_dtype = wire_dtype
         # decode pool width (tf_cnn_benchmarks --datasets_num_private_threads
-        # analog); None = auto-size to the host's cores, 0/1 = serial
-        if decode_workers is None:
+        # analog); 0/None = auto-size to the host's cores (matching the CLI
+        # flag's 0=auto convention), 1 = serial
+        if not decode_workers:
             decode_workers = max(1, min(32, (os.cpu_count() or 2) - 1))
         self.decode_workers = decode_workers
 

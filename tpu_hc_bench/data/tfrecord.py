@@ -99,6 +99,28 @@ def read_records(
             yield data
 
 
+def count_records(path: Path | str) -> int:
+    """Count records by seeking over payloads (no CRC, no parse) — cheap
+    enough to size an epoch (--num_epochs) from the actual shards."""
+    import os
+
+    n = 0
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        pos = 0
+        while pos < size:
+            header = f.read(8)
+            if len(header) < 8:
+                raise IOError(f"{path}: truncated length header")
+            (length,) = struct.unpack("<Q", header)
+            pos += 8 + 4 + length + 4
+            f.seek(pos)
+            n += 1
+    if pos > size:
+        raise IOError(f"{path}: truncated record")
+    return n
+
+
 # ---------------------------------------------------------------------------
 # Minimal protobuf wire codec for tf.train.Example
 # ---------------------------------------------------------------------------

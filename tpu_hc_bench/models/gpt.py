@@ -18,15 +18,15 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from tpu_hc_bench.models.bert import MultiHeadAttention, global_position_ids
 
 GPT2_VOCAB = 50257
 GPT2_CTX = 1024
-# Dropout rates shared with the pipeline-parallel re-implementation of the
-# forward (parallel/pipeline.py builds the GPTLM math from DecoderLayer +
-# these constants — change them here and both paths move together).
+# Dropout rates shared by __call__ and the pp_embed/pp_head PP interface
+# below — change them here and both paths move together.
 EMBED_DROPOUT = 0.1
 RESID_DROPOUT = 0.1
 
@@ -124,6 +124,50 @@ class GPTLM(nn.Module):
             embed.embedding.astype(self.dtype),
             preferred_element_type=jnp.float32,
         )
+
+    # --- pipeline-parallel interface (parallel/pipeline.py) -------------
+    # Three pure functions over the model's OWN param tree, so the PP step
+    # is derived from the model instead of reconstructing its wiring; any
+    # decoder exposing these (+ `layer_i` param naming, num_layers, remat)
+    # can pipeline.  Must stay numerically identical to __call__ (pinned
+    # by tests/test_pipeline.py parity tests).
+
+    @nn.nowrap
+    def pp_layer_module(self) -> nn.Module:
+        """The repeated trunk layer, identical to the `layer_i` instances
+        built in ``__call__`` (same param tree as one stacked slice)."""
+        return DecoderLayer(
+            self.hidden, self.heads, self.ffn, dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            num_experts=self.num_experts, top_k=self.top_k,
+            moe_impl=self.moe_impl,
+            moe_capacity_factor=self.moe_capacity_factor)
+
+    @nn.nowrap
+    def pp_embed(self, params: dict, token_ids, rng):
+        """Token + learned-position embedding (+ embed dropout when
+        ``rng`` is given); returns ``(x, rng)`` with the embed-dropout
+        fold consumed from ``rng``."""
+        wte = params["wte"]["embedding"]
+        wpe = params["wpe"]["embedding"]
+        s = token_ids.shape[1]
+        x = (wte.astype(self.dtype)[token_ids]
+             + wpe.astype(self.dtype)[jnp.arange(s)][None])
+        if rng is not None:
+            rng, ekey = jax.random.split(rng)
+            x = nn.Dropout(EMBED_DROPOUT, deterministic=False).apply(
+                {}, x, rngs={"dropout": ekey})
+        return x, rng
+
+    @nn.nowrap
+    def pp_head(self, params: dict, x):
+        """Final LN + tied f32-accumulated output projection."""
+        x = nn.LayerNorm(dtype=self.dtype).apply(
+            {"params": params["ln_f"]}, x)
+        return jnp.einsum(
+            "bsh,vh->bsv", x.astype(self.dtype),
+            params["wte"]["embedding"].astype(self.dtype),
+            preferred_element_type=jnp.float32)
 
 
 def gpt2(num_classes: int = 0, dtype=jnp.float32,

@@ -166,6 +166,32 @@ class LlamaLM(nn.Module):
                           head.astype(self.dtype),
                           preferred_element_type=jnp.float32)
 
+    # --- pipeline-parallel interface (parallel/pipeline.py) -------------
+    # Same contract as GPTLM's: the PP step builder derives the stage
+    # forward from these instead of hardcoding any family's wiring.
+
+    @nn.nowrap
+    def pp_layer_module(self) -> nn.Module:
+        return LlamaBlock(
+            self.hidden, self.heads, self.num_kv_heads, self.ffn,
+            self.max_len, dtype=self.dtype,
+            attention_impl=self.attention_impl)
+
+    @nn.nowrap
+    def pp_embed(self, params: dict, token_ids, rng):
+        """Token embedding only (no positions here — RoPE rotates inside
+        attention; no embed dropout in the Llama family)."""
+        emb = params["tok_embed"]["embedding"]
+        return emb.astype(self.dtype)[token_ids], rng
+
+    @nn.nowrap
+    def pp_head(self, params: dict, x):
+        x = RMSNorm(dtype=self.dtype).apply(
+            {"params": params["final_norm"]}, x)
+        return jnp.einsum("bsh,hv->bsv", x.astype(self.dtype),
+                          params["lm_head"].astype(self.dtype),
+                          preferred_element_type=jnp.float32)
+
 
 def llama_1b(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,

@@ -9,8 +9,10 @@ training step.  The backward schedule is not hand-written: JAX transposes
 the forward scan, turning each ``ppermute`` into its reverse hop, which
 *is* GPipe's backward pass.
 
-Layer-to-stage mapping reuses the GPT decoder family's parameter tree
-verbatim: ``stack_layer_params`` stacks the ``layer_i`` subtrees into one
+Layer-to-stage mapping reuses the decoder families' parameter trees
+verbatim (any model exposing the ``pp_embed``/``pp_layer_module``/
+``pp_head`` interface with ``layer_i`` param naming — GPTLM and LlamaLM):
+``stack_layer_params`` stacks the ``layer_i`` subtrees into one
 ``[L, ...]`` pytree whose leading dim shards over the pipe axis
 (``L / n_pipe`` layers per stage, applied with an inner ``lax.scan`` —
 scan-over-layers).  Embedding and head replicate and run on every stage;
@@ -114,10 +116,25 @@ def pipeline_apply(block_fn, stage_params, x_mb, axis_name: str = PIPE_AXIS,
 
 
 def stack_layer_params(params: dict, num_layers: int) -> dict:
-    """GPT param tree -> {'trunk': [L, ...] stacked layers, <rest>}."""
+    """Decoder param tree (``layer_i`` naming) -> {'trunk': [L, ...]
+    stacked layers, <rest>}.
+
+    Host (numpy) leaves stack with ``np.stack`` so the checkpoint-
+    interchange path never materializes the full stacked trunk on the
+    default device — ``place_pp_state`` then does the only transfer,
+    straight into the pipe sharding (a PP model may not fit one device).
+    """
+    import numpy as np
+
     layers = [params[f"layer_{i}"] for i in range(num_layers)]
     rest = {k: v for k, v in params.items() if not k.startswith("layer_")}
-    rest["trunk"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+    def stack(*xs):
+        if all(isinstance(x, np.ndarray) for x in xs):
+            return np.stack(xs)
+        return jnp.stack(xs)
+
+    rest["trunk"] = jax.tree.map(stack, *layers)
     return rest
 
 
@@ -210,32 +227,25 @@ def _opt_specs(opt_state, param_specs: dict, params: dict):
 def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
                         example_params: dict, example_opt_state,
                         deterministic: bool = False, tp: bool = False):
-    """DP x PP training step for the GPT decoder family.
+    """DP x PP training step for any decoder exposing the PP interface.
 
-    ``model`` is a ``GPTLM`` whose params have been restacked with
-    ``stack_layer_params``.  The step is a ``shard_map`` over the
-    ``(data, pipe)`` mesh: batch sharded over data, trunk sharded over
-    pipe, embed/head replicated.  Forward matches ``GPTLM.__call__``
-    (embed + pos + dropout, pipelined pre-LN decoder layers, final LN,
-    tied f32 output projection); ``deterministic=True`` disables dropout
-    (the numerically-testable mode, = ``train=False``).  MoE layers'
-    Switch aux losses ARE collected: each stage sums its layers' sown
-    terms over the valid microbatches (``pipeline_apply``), and the
-    per-microbatch-grouped mean joins the objective at ``AUX_LOSS_COEF``
-    (a grouped estimator of the same Switch statistic — not bitwise the
-    full-batch value; see the note in ``device_step``).
+    ``model`` implements ``pp_embed`` / ``pp_layer_module`` / ``pp_head``
+    (GPTLM and LlamaLM today) and its params have been restacked with
+    ``stack_layer_params``.  The stage forward is DERIVED from those
+    methods — no per-family wiring lives here.  The step is a
+    ``shard_map`` over the ``(data, pipe)`` mesh: batch sharded over
+    data, trunk sharded over pipe, embed/head replicated.
+    ``deterministic=True`` disables dropout (the numerically-testable
+    mode, = ``train=False``).  MoE layers' Switch aux losses ARE
+    collected: each stage sums its layers' sown terms over the valid
+    microbatches (``pipeline_apply``), and the per-microbatch-grouped
+    mean joins the objective at ``AUX_LOSS_COEF`` (a grouped estimator of
+    the same Switch statistic — not bitwise the full-batch value; see the
+    note in ``device_step``).
     """
-    from flax import linen as nn
-
-    from tpu_hc_bench.models.gpt import DecoderLayer
     from tpu_hc_bench.train.step import make_optimizer
 
-    layer = DecoderLayer(model.hidden, model.heads, model.ffn,
-                         dtype=model.dtype, num_experts=model.num_experts,
-                         top_k=model.top_k, moe_impl=model.moe_impl,
-                         moe_capacity_factor=model.moe_capacity_factor,
-                         attention_impl=model.attention_impl)
-    ln_f = nn.LayerNorm(dtype=model.dtype)
+    layer = model.pp_layer_module()
     tx = make_optimizer(cfg)
 
     def block_fn(p, h, key):
@@ -252,28 +262,13 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
         block_fn = jax.checkpoint(block_fn)
 
     def forward(params, tokens, rng):
-        wte = params["wte"]["embedding"]
-        wpe = params["wpe"]["embedding"]
         b, s = tokens.shape
-        x = (wte.astype(model.dtype)[tokens]
-             + wpe.astype(model.dtype)[jnp.arange(s)][None])
-        if rng is not None:
-            # GPTLM's post-embedding dropout, at the shared rate constant
-            from tpu_hc_bench.models.gpt import EMBED_DROPOUT
-
-            rng, ekey = jax.random.split(rng)
-            x = nn.Dropout(EMBED_DROPOUT, deterministic=False).apply(
-                {}, x, rngs={"dropout": ekey})
+        x, rng = model.pp_embed(params, tokens, rng)
         mb = b // num_microbatches
         xs = x.reshape(num_microbatches, mb, s, model.hidden)
         ys, aux = pipeline_apply(block_fn, params["trunk"], xs, rng=rng)
         x = ys.reshape(b, s, model.hidden)
-        x = ln_f.apply({"params": params["ln_f"]}, x)
-        # compute-dtype operands + f32 accumulation, matching GPTLM's head
-        logits = jnp.einsum("bsh,vh->bsv", x.astype(model.dtype),
-                            wte.astype(model.dtype),
-                            preferred_element_type=jnp.float32)
-        return logits, aux
+        return model.pp_head(params, x), aux
 
     def device_step(params, opt_state, batch, rng):
         tokens, targets, weights = batch
@@ -373,7 +368,8 @@ def place_pp_state(params: dict, opt_state, mesh: Mesh, tp: bool = False):
 
 
 def make_pp_state(model, cfg, example_tokens, mesh: Mesh, tp: bool = False):
-    """Init GPTLM params, restack layers for the pipe axis, init SGD.
+    """Init the decoder's params, restack layers for the pipe axis,
+    init the optimizer.
 
     Returns ``(params, opt_state)`` placed on the mesh (trunk sharded over
     pipe, everything else replicated).

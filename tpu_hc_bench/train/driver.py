@@ -270,11 +270,15 @@ def _save_state(state, cfg, print_fn, pp_ctx=None):
 
 
 def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
-              fab, print_fn):
-    """tf_cnn_benchmarks --eval: timed forward passes + top-1 accuracy."""
+              fab, print_fn, follow_inputs=False):
+    """tf_cnn_benchmarks --eval: timed forward passes + top-1 accuracy.
+
+    ``follow_inputs=True``: TP/EP eval — the state enters model-sharded
+    and the GSPMD eval step follows its committed shardings."""
     from tpu_hc_bench.train import step as step_mod
 
-    eval_step = step_mod.build_eval_step(mesh, cfg, spec)
+    eval_step = step_mod.build_eval_step(mesh, cfg, spec,
+                                         follow_inputs=follow_inputs)
     units = _example_units(cfg, spec)
     for _ in range(max(1, min(cfg.num_warmup_batches, 5))):
         loss, correct = eval_step(state, next(batch_iter))
@@ -334,6 +338,14 @@ def run_benchmark(
 
     fab = fabric_mod.resolve_fabric(fabric_name)
     layout = layout or discover_layout()
+    if cfg.train_dir and jax.process_count() > 1:
+        # utils.checkpoint is single-controller: host 0 device_gets the
+        # FULL state (non-addressable under a multi-host mesh -> raises),
+        # and restore on hosts without a shared filesystem would diverge.
+        raise ValueError(
+            "--train_dir checkpointing is single-process only: multi-host "
+            "save/restore needs per-shard Orbax I/O + a barrier (save the "
+            "checkpoint from a 1-process run, or drop --train_dir here)")
     # TP/EP claim the mesh's "model" axis, PP "pipe", SP "seq".  Round 2:
     # minor axes COMPOSE — DPxPPxTP and DPxSPxTP are the supported 3-D
     # hybrids (PP/SP manual shard_map axes, model auto/GSPMD); the other
@@ -372,21 +384,6 @@ def run_benchmark(
     # at fixed per-worker batch) shrinks by the minor-axis product
     global_batch = layout.global_batch(cfg.batch_size) // mp
 
-    if cfg.num_epochs:
-        # tf_cnn_benchmarks --num_epochs: duration in dataset passes,
-        # resolvable only here (needs the global batch); eval epochs run
-        # over the validation split's size.  num_epochs is cleared after
-        # derivation so the cfg stays re-resolvable.
-        import math
-
-        examples = 50_000 if cfg.eval else 1_281_167   # ilsvrc2012 splits
-        cfg.num_batches = math.ceil(
-            cfg.num_epochs * examples / global_batch)
-        print_fn(f"num_epochs={cfg.num_epochs} -> "
-                 f"num_batches={cfg.num_batches} "
-                 f"(global_batch={global_batch})")
-        cfg.num_epochs = 0.0
-
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, attention_impl=cfg.attention_impl,
@@ -407,6 +404,45 @@ def run_benchmark(
             raise ValueError("--eval with --sequence_parallel is not "
                              "supported")
 
+    # real-data split, resolved ONCE: both the --num_epochs sizing and
+    # the dataset construction below must read the same shards (eval
+    # prefers a validation split when present, else falls back to train)
+    data_split = None
+    if cfg.data_dir is not None and not spec.is_text:
+        from tpu_hc_bench.data.imagenet import find_shards
+
+        data_split = "train"
+        if cfg.eval:
+            try:
+                find_shards(cfg.data_dir, "validation")
+                data_split = "validation"
+            except FileNotFoundError:
+                pass
+
+    if cfg.num_epochs:
+        # tf_cnn_benchmarks --num_epochs: duration in dataset passes,
+        # resolvable only here (needs the global batch and the ACTUAL
+        # dataset — synthetic/text streams have no epoch size, so they
+        # reject rather than silently assume ilsvrc2012 splits).
+        # num_epochs is cleared after derivation so cfg stays
+        # re-resolvable.
+        import math
+
+        if data_split is None:
+            raise ValueError(
+                "--num_epochs needs a real image dataset (--data_dir): "
+                "synthetic and text inputs are endless streams with no "
+                "epoch size; use --num_batches")
+        from tpu_hc_bench.data.imagenet import count_examples
+
+        examples = count_examples(cfg.data_dir, data_split)
+        cfg.num_batches = math.ceil(
+            cfg.num_epochs * examples / global_batch)
+        print_fn(f"num_epochs={cfg.num_epochs} ({examples} examples) -> "
+                 f"num_batches={cfg.num_batches} "
+                 f"(global_batch={global_batch})")
+        cfg.num_epochs = 0.0
+
     # --- banner (reference :52-58 config echo) ---
     for line in layout.summary_lines(fabric=fab.value):
         print_fn(line)
@@ -424,22 +460,11 @@ def run_benchmark(
         from tpu_hc_bench.data.imagenet import ImageNetDataset
 
         image_size = spec.default_image_size
-        split = "train"
-        if cfg.eval:
-            # prefer a validation split when present (standard layout);
-            # fall back to train shards otherwise
-            from tpu_hc_bench.data.imagenet import find_shards
-
-            try:
-                find_shards(cfg.data_dir, "validation")
-                split = "validation"
-            except FileNotFoundError:
-                pass
         ds = ImageNetDataset(
             cfg.data_dir,
             global_batch=global_batch,
             image_size=image_size,
-            split=split,
+            split=data_split,
             train=not cfg.eval,
             worker=jax.process_index(),
             num_workers=jax.process_count(),
@@ -447,8 +472,9 @@ def run_benchmark(
             # uint8 ships 4x less host->device traffic; the cast+normalize
             # runs inside the compiled step (train.step.prep_inputs)
             wire_dtype=cfg.wire_dtype,
-            # 0 = auto-size the decode pool to the host's cores
-            decode_workers=cfg.datasets_num_private_threads or None,
+            # 0 = auto-size the decode pool to the host's cores (the
+            # dataset normalizes 0/None = auto, 1 = serial)
+            decode_workers=cfg.datasets_num_private_threads,
         )
         host_iter = iter(ds)
         batch = next(host_iter)
@@ -514,15 +540,15 @@ def run_benchmark(
     elif pp > 1:
         if cfg.eval:
             raise ValueError("--eval with --pipeline_parallel is not supported")
-        from tpu_hc_bench.models.gpt import GPTLM
-
-        # build_pp_train_step reconstructs the GPT forward (wte/wpe/
-        # DecoderLayer trunk), so llama etc. must be rejected here even
-        # though they are causal LMs too
-        if not isinstance(model, GPTLM):
+        # the PP step builder derives the stage forward from the model's
+        # pp_embed/pp_layer_module/pp_head interface (GPT + llama
+        # families); models without it (CNNs, encoder-only) can't pipeline
+        if not all(hasattr(model, m) for m in
+                   ("pp_embed", "pp_layer_module", "pp_head")):
             raise ValueError(
-                "--pipeline_parallel currently supports the GPT decoder "
-                f"family (GPTLM), not {cfg.model}")
+                "--pipeline_parallel requires a decoder implementing the "
+                "PP interface (pp_embed/pp_layer_module/pp_head: the GPT "
+                f"and llama families), not {cfg.model}")
         from tpu_hc_bench.parallel import pipeline as pipe_mod
 
         if model.num_layers % pp:
@@ -585,12 +611,9 @@ def run_benchmark(
             state = step_mod.replicate_state(state, mesh)
         batch_iter = batches()
         if cfg.eval:
-            if mp > 1:
-                raise ValueError(
-                    "--eval with --model_parallel is not supported")
             return _run_eval(
                 cfg, spec, layout, mesh, state, batch_iter, global_batch,
-                fab, print_fn,
+                fab, print_fn, follow_inputs=mp > 1,
             )
         train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
     rng = jax.random.PRNGKey(cfg.seed + 17)

@@ -404,11 +404,19 @@ def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
     return step
 
 
-def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
+def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
+                    follow_inputs: bool = False):
     """Eval step (tf_cnn_benchmarks --eval): forward pass, loss + top-1.
 
     Uses running BN statistics (``train=False``) and no dropout.  Returns
     ``(loss, correct_count)`` reduced over the mesh.
+
+    ``follow_inputs=True`` is the TP/EP arm (same trick as
+    ``_build_gspmd_step(follow_inputs=True)``): the step is written over
+    the global batch with no shard_map, the model-sharded params enter
+    committed (``shard_state_tp``) and jit follows them — GSPMD inserts
+    the Megatron all-reduces in the forward, so a TP-trained state
+    evaluates in its native sharding instead of being re-replicated.
     """
     is_text = spec.is_text
 
@@ -423,21 +431,34 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec):
             losses = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
             )
-            loss = (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+            num, den = (losses * weights).sum(), weights.sum()
             correct = jnp.sum(
                 (jnp.argmax(logits, -1) == targets) * weights
             )
+            if not follow_inputs:
+                # psum numerator/denominator separately: the GLOBAL
+                # weighted mean (a mean of per-shard means would weight
+                # shards equally regardless of their valid-token counts,
+                # and the DP vs TP eval arms must report the same number)
+                num = jax.lax.psum(num, DATA_AXIS)
+                den = jax.lax.psum(den, DATA_AXIS)
+            loss = num / jnp.maximum(den, 1.0)
         else:
             _, labels = batch
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels
             ).mean()
+            if not follow_inputs:
+                loss = jax.lax.pmean(loss, DATA_AXIS)
             correct = jnp.sum(jnp.argmax(logits, -1) == labels)
-        return (
-            jax.lax.pmean(loss, DATA_AXIS),
-            jax.lax.psum(correct.astype(jnp.float32), DATA_AXIS),
-        )
+        correct = correct.astype(jnp.float32)
+        if follow_inputs:
+            # global-batch program: loss/correct are already global
+            return loss, correct
+        return loss, jax.lax.psum(correct, DATA_AXIS)
 
+    if follow_inputs:
+        return jax.jit(device_eval)
     shard_fn = jax.shard_map(
         device_eval,
         mesh=mesh,
