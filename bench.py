@@ -46,6 +46,10 @@ def main() -> int:
         # "space_to_depth re-measured").  Models without an s2d stem are
         # rejected loudly by create_model.
         use_space_to_depth=os.environ.get("BENCH_S2D", "0") == "1",
+        # round 3: Pallas fused bottleneck segment (BENCH_FUSED_CONV=1 to
+        # enable; only the v1 bottleneck resnets accept it, so default off
+        # keeps every BENCH_MODEL working)
+        fused_conv=os.environ.get("BENCH_FUSED_CONV", "0") == "1",
     ).resolve()
 
     # human-readable progress to stderr; stdout carries only the JSON line

@@ -1,0 +1,211 @@
+"""Round 3: REAL-conv fused bottleneck-segment A/B (VERDICT #1).
+
+Round 2's matmul-proxy (`exp_fused_bnstats.py`) showed XLA already fuses
+BN-STAT reductions into a matmul's output stream — but it could not
+answer the conv question: the roofline's remaining headroom is the
+BN-APPLY + relu pass between convs (normalize the producer's raw output
+in the consumer's prologue), and convs have different XLA fusion behavior
+than ``dot``.
+
+This experiment builds the real thing for the ResNet-50 stage-1 conv2
+segment (the profiled pathology):
+
+    y1_raw [B, 56, 56, 64] (pre-BN conv1 output, bf16, in HBM)
+    xn     = relu(y1_raw * a + b)     # BN-apply folded to scale/shift
+    y2     = conv3x3(xn, w)           # SAME, NHWC, bf16 in / f32 acc
+    s1, s2 = y2.sum((0,1,2)), (y2*y2).sum((0,1,2))   # next BN's stats
+
+Arms (identical math, chained ITERS deep inside one jit so the ~4 ms
+tunnel dispatch cost amortizes; sync via device_get per the env notes):
+
+  xla          lax.conv_general_dilated with the normalize+relu as a
+               producer and the stat reductions as consumers — XLA fuses
+               whatever it can.
+  pallas_fused one kernel per image: prologue normalizes into a padded
+               VMEM scratch (the halo), 9 shifted [3136,64]x[64,64] MXU
+               taps accumulate in f32, epilogue streams y2 out while
+               accumulating per-channel sum/sumsq across the grid.
+  xla_conv     conv alone (no BN/relu/stats) — the conv compute floor.
+
+If pallas_fused beats xla by >~15% the fused-bottleneck integration is
+worth building; if it matches, XLA is already at the fused bound for the
+conv pattern too and the round-2 conclusion extends to convs — either way
+this closes VERDICT round-3 item #1's measurement demand.
+
+Usage: python scripts/exp_fused_conv.py [B] [H] [C]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+H = int(sys.argv[2]) if len(sys.argv) > 2 else 56
+C = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+ITERS = 20
+
+
+def _kernel(x_ref, w_ref, a_ref, b_ref, y_ref, s1_ref, s2_ref,
+            xn_ref, sacc1, sacc2):
+    """One image per program: prologue BN-apply+relu -> 9-tap conv ->
+    epilogue stats."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sacc1[...] = jnp.zeros_like(sacc1)
+        sacc2[...] = jnp.zeros_like(sacc2)
+
+    # --- prologue: normalize + relu into the padded (halo) scratch ---
+    x = x_ref[0].astype(jnp.float32)                       # [H, H, C]
+    xn = jnp.maximum(x * a_ref[...] + b_ref[...], 0.0)
+    xn_ref[...] = jnp.zeros_like(xn_ref)                   # zero halo
+    xn_ref[1:H + 1, 1:H + 1, :] = xn.astype(xn_ref.dtype)
+
+    # --- 9 shifted MXU taps, f32 accumulation ---
+    acc = jnp.zeros((H * H, C), jnp.float32)
+    for dh in range(3):
+        for dw in range(3):
+            patch = xn_ref[dh:dh + H, dw:dw + H, :].reshape(H * H, C)
+            acc += jnp.dot(patch, w_ref[dh, dw],
+                           preferred_element_type=jnp.float32)
+
+    # --- epilogue: stream out + accumulate next-BN stats ---
+    y_ref[...] = acc.reshape(1, H, H, C).astype(y_ref.dtype)
+    sacc1[...] += acc.sum(axis=0, keepdims=True)
+    sacc2[...] += (acc * acc).sum(axis=0, keepdims=True)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _flush():
+        s1_ref[...] = sacc1[...]
+        s2_ref[...] = sacc2[...]
+
+
+@jax.jit
+def pallas_fused(x, w, a, b):
+    y, s1, s2 = pl.pallas_call(
+        _kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, H, C), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, C, C), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, H, C), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, H, C), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((H + 2, H + 2, C), jnp.bfloat16),
+            pltpu.VMEM((1, C), jnp.float32),
+            pltpu.VMEM((1, C), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(x, w, a, b)
+    return y, s1, s2
+
+
+def _xla_math(x, w, a, b):
+    xn = jnp.maximum(x.astype(jnp.float32) * a[0] + b[0], 0.0)
+    y = jax.lax.conv_general_dilated(
+        xn.astype(jnp.bfloat16), w, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    s1 = y.sum((0, 1, 2))[None]
+    s2 = (y * y).sum((0, 1, 2))[None]
+    return y.astype(jnp.bfloat16), s1, s2
+
+
+xla_ref = jax.jit(_xla_math)
+
+
+@jax.jit
+def xla_conv_only(x, w, a, b):
+    del a, b
+    y = jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    z = jnp.zeros((1, C), jnp.float32)
+    return y.astype(jnp.bfloat16), z, z
+
+
+def bench(name, fn, x, w, a, b):
+    """Chained timing: each iteration's input depends on the previous
+    output (no overlap-cheating), one jit, value-fetch sync (tunnel)."""
+    @jax.jit
+    def chained(x, w, a, b):
+        def body(_, carry):
+            xc, s_acc = carry
+            y, s1, s2 = fn(xc, w, a, b)
+            # feed y back at ~zero magnitude: keeps y + stats live
+            xc = xc + y * jnp.bfloat16(1e-6)
+            return xc, s_acc + s1 + s2
+        return jax.lax.fori_loop(
+            0, ITERS, body, (x, jnp.zeros((1, C), jnp.float32)))
+
+    out = fn(x, w, a, b)
+    jax.device_get(out[1])
+    r = chained(x, w, a, b)
+    jax.device_get(r[1])                     # warm
+    t0 = time.perf_counter()
+    r = chained(x, w, a, b)
+    jax.device_get(r[1])
+    dt = (time.perf_counter() - t0) / ITERS
+    flops = 2 * B * H * H * C * C * 9
+    io_bytes = 2 * (B * H * H * C * 2)       # read x + write y, bf16
+    print(f"{name:14s} {1e3 * dt:7.3f} ms  {flops / dt / 1e12:6.2f} TF/s  "
+          f"io {io_bytes / dt / 1e9:6.1f} GB/s", flush=True)
+    return out, dt
+
+
+def main():
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (B, H, H, C), jnp.bfloat16)
+    w = (jax.random.normal(jax.random.fold_in(k, 1), (3, 3, C, C),
+                           jnp.bfloat16) * 0.05)
+    a = jnp.abs(jax.random.normal(jax.random.fold_in(k, 2), (1, C),
+                                  jnp.float32)) * 0.5 + 0.5
+    b = jax.random.normal(jax.random.fold_in(k, 3), (1, C),
+                          jnp.float32) * 0.1
+    print(f"segment: [{B},{H},{H},{C}] -> 3x3x{C} (SAME) + BN-apply/relu "
+          f"prologue + stats epilogue, ITERS={ITERS}")
+    (y_r, s1_r, s2_r), t_x = bench("xla", xla_ref, x, w, a, b)
+    bench("xla_conv_only", xla_conv_only, x, w, a, b)
+    try:
+        (y_f, s1_f, s2_f), t_f = bench("pallas_fused", pallas_fused,
+                                       x, w, a, b)
+    except Exception as e:
+        print(f"pallas_fused failed: {type(e).__name__}: {str(e)[:300]}")
+        return
+    np.testing.assert_allclose(np.asarray(s1_f), np.asarray(s1_r),
+                               rtol=2e-2, atol=2.0)
+    np.testing.assert_allclose(np.asarray(s2_f), np.asarray(s2_r),
+                               rtol=2e-2, atol=4.0)
+    np.testing.assert_allclose(
+        np.asarray(y_f[:2], np.float32), np.asarray(y_r[:2], np.float32),
+        rtol=5e-2, atol=1e-1)
+    print(f"numerics ok; fused/xla = {t_f / t_x:.3f}x "
+          f"({'WIN' if t_f < 0.87 * t_x else 'no win'})")
+
+
+if __name__ == "__main__":
+    main()

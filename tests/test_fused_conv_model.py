@@ -1,0 +1,147 @@
+"""Fused bottleneck modules vs the plain composition (param-copied).
+
+The ops-level numerics live in tests/test_fused_conv.py; these tests pin
+the MODEL integration: FusedBNReluConv3x3 == BatchNorm->relu->Conv,
+FusedBottleneckBlock == BottleneckBlock, running-stat updates match, and
+the --fused_conv flag reaches the driver end to end.
+"""
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_hc_bench.models import resnet
+
+
+def _plain_seg(use_running_average):
+    """BatchNorm -> relu -> 3x3 conv, the unfused composition."""
+
+    class Seg(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            y = nn.BatchNorm(use_running_average=use_running_average,
+                             momentum=0.9, epsilon=1e-5, name="bn")(x)
+            y = nn.relu(y)
+            return nn.Conv(8, (3, 3), use_bias=False, padding="SAME",
+                           name="conv")(y)
+
+    return Seg()
+
+
+def _copy_seg_params(fused_vars):
+    """Map FusedBNReluConv3x3's tree onto the plain segment's."""
+    p = fused_vars["params"]
+    bs = fused_vars["batch_stats"]
+    return {
+        "params": {
+            "bn": {"scale": p["scale"], "bias": p["bias"]},
+            "conv": {"kernel": p["kernel"]},
+        },
+        "batch_stats": {"bn": {"mean": bs["mean"], "var": bs["var"]}},
+    }
+
+
+def test_fused_module_matches_plain_train_and_eval():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8, 8))
+    for use_ra in (False, True):
+        fused = resnet.FusedBNReluConv3x3(8, use_running_average=use_ra)
+        fvars = fused.init(jax.random.PRNGKey(1), x)
+        plain = _plain_seg(use_ra)
+        pvars = _copy_seg_params(fvars)
+
+        (y_f, (s1, s2)), fupd = fused.apply(fvars, x,
+                                            mutable=["batch_stats"])
+        y_p, pupd = plain.apply(pvars, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_p),
+                                   rtol=1e-5, atol=1e-5)
+        # the epilogue stats equal a direct reduction over y
+        yf = np.asarray(y_f, np.float64)
+        np.testing.assert_allclose(np.asarray(s1), yf.sum((0, 1, 2)),
+                                   rtol=1e-4, atol=1e-3)
+        if not use_ra:
+            # running-average updates match nn.BatchNorm's
+            np.testing.assert_allclose(
+                np.asarray(fupd["batch_stats"]["mean"]),
+                np.asarray(pupd["batch_stats"]["bn"]["mean"]),
+                rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(fupd["batch_stats"]["var"]),
+                np.asarray(pupd["batch_stats"]["bn"]["var"]),
+                rtol=1e-4, atol=1e-5)
+
+
+def _copy_block_params(fused_vars):
+    """FusedBottleneckBlock tree -> BottleneckBlock tree."""
+    p, bs = fused_vars["params"], fused_vars["batch_stats"]
+    seg_p, seg_bs = p["FusedBNReluConv3x3_0"], bs["FusedBNReluConv3x3_0"]
+    sbn_p, sbn_bs = p["StatsBatchNorm_0"], bs["StatsBatchNorm_0"]
+    out_p = {
+        "Conv_0": p["Conv_0"],
+        "BatchNorm_0": {"scale": seg_p["scale"], "bias": seg_p["bias"]},
+        "Conv_1": {"kernel": seg_p["kernel"]},
+        "BatchNorm_1": {"scale": sbn_p["scale"], "bias": sbn_p["bias"]},
+        "Conv_2": p["Conv_1"],
+        "BatchNorm_2": p["BatchNorm_0"],
+    }
+    out_bs = {
+        "BatchNorm_0": {"mean": seg_bs["mean"], "var": seg_bs["var"]},
+        "BatchNorm_1": {"mean": sbn_bs["mean"], "var": sbn_bs["var"]},
+        "BatchNorm_2": bs["BatchNorm_0"],
+    }
+    for k in ("shortcut_conv",):
+        if k in p:
+            out_p[k] = p[k]
+    for k in ("shortcut_bn",):
+        if k in p:
+            out_p[k] = p[k]
+            out_bs[k] = bs[k]
+    return {"params": out_p, "batch_stats": out_bs}
+
+
+def _mk_blocks(train, strides=1):
+    conv = functools.partial(nn.Conv, use_bias=False, padding="SAME")
+    norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                             momentum=0.9, epsilon=1e-5)
+    kw = dict(filters=4, strides=strides, conv=conv, norm=norm, act=nn.relu)
+    return (resnet.FusedBottleneckBlock(use_running_average=not train, **kw),
+            resnet.BottleneckBlock(**kw))
+
+
+def test_fused_block_matches_plain():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 16))
+    for train in (True, False):
+        for strides in (1, 2):
+            fused, plain = _mk_blocks(train, strides)
+            fvars = fused.init(jax.random.PRNGKey(1), x)
+            pvars = _copy_block_params(fvars)
+            y_f, _ = fused.apply(fvars, x, mutable=["batch_stats"])
+            y_p, _ = plain.apply(pvars, x, mutable=["batch_stats"])
+            np.testing.assert_allclose(
+                np.asarray(y_f), np.asarray(y_p), rtol=1e-5, atol=1e-5,
+                err_msg=f"train={train} strides={strides}")
+
+
+def test_fused_resnet_through_driver(mesh8):
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.train import driver
+
+    cfg = flags.BenchmarkConfig(
+        model="resnet50", batch_size=1, num_warmup_batches=1, num_batches=2,
+        display_every=1, num_classes=10, fused_conv=True,
+    ).resolve()
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    assert np.isfinite(res.final_loss)
+
+
+def test_fused_conv_rejected_for_non_bottleneck():
+    from tpu_hc_bench.models import create_model
+    import pytest
+
+    with pytest.raises(ValueError, match="fused_conv"):
+        create_model("vgg16", fused_conv=True)
+    with pytest.raises(ValueError, match="fused_conv"):
+        create_model("resnet18", fused_conv=True)

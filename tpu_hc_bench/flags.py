@@ -125,6 +125,12 @@ class BenchmarkConfig:
     trace_dir: str | None = None              # jax.profiler trace output; the
                                               # structured upgrade of the
                                               # reference's I_MPI_DEBUG tracing
+    num_slices: int = 0                       # fabric=dcn multislice layout:
+                                              # slices x hosts/slice x chips
+                                              # (0 = one slice per host)
+    fused_conv: bool = False                  # Pallas fused BN-relu-conv3x3
+                                              # bottleneck segment (v1
+                                              # resnets; ops/fused_conv.py)
     fused_xent: bool = False                  # Pallas blocked cross-entropy
                                               # for large-vocab (MLM) heads
     use_space_to_depth: bool = False          # ResNet stem as 4x4/s1 conv on
@@ -389,6 +395,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--trace_dir", type=str, default=None)
+    p.add_argument("--num_slices", type=int, default=d.num_slices)
+    p.add_argument("--fused_conv", type=_parse_bool, default=d.fused_conv)
     p.add_argument("--fused_xent", type=_parse_bool, default=False)
     p.add_argument("--use_space_to_depth", type=_parse_bool,
                    default=d.use_space_to_depth)

@@ -48,6 +48,9 @@ class ModelSpec:
     moe: bool = False                  # factory accepts moe_impl
     attention: bool = False            # image transformer (ViT): factory
                                        # accepts attention_impl/remat
+    fused_conv: bool = False           # factory accepts fused_conv (the
+                                       # Pallas bottleneck segment, v1
+                                       # bottleneck resnets only)
 
 
 def _registry() -> dict[str, ModelSpec]:
@@ -81,11 +84,11 @@ def _registry() -> dict[str, ModelSpec]:
         ModelSpec("resnet34", resnet.resnet34, (224, 224, 3), 7.34e9,
                   supports_s2d=True),
         ModelSpec("resnet50", resnet.resnet50, (224, 224, 3), 8.2e9,
-                  supports_s2d=True),
+                  supports_s2d=True, fused_conv=True),
         ModelSpec("resnet101", resnet.resnet101, (224, 224, 3), 15.7e9,
-                  supports_s2d=True),
+                  supports_s2d=True, fused_conv=True),
         ModelSpec("resnet152", resnet.resnet152, (224, 224, 3), 23.1e9,
-                  supports_s2d=True),
+                  supports_s2d=True, fused_conv=True),
         # v2 (full preactivation) — same conv stack, same 2*MAC figures
         ModelSpec("resnet50_v2", resnet.resnet50_v2, (224, 224, 3), 8.2e9,
                   supports_s2d=True),
@@ -185,7 +188,8 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                  seq_len: int | None = None,
                  gradient_checkpointing: bool = False,
                  moe_impl: str = "einsum", seq_axis: str | None = None,
-                 moe_capacity_factor: float = 1.25):
+                 moe_capacity_factor: float = 1.25,
+                 fused_conv: bool = False):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
     if spec.moe:
@@ -226,4 +230,9 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
         kwargs["space_to_depth"] = space_to_depth
     elif space_to_depth:
         raise ValueError(f"--use_space_to_depth: {name} has no s2d stem")
+    if spec.fused_conv:
+        kwargs["fused_conv"] = fused_conv
+    elif fused_conv:
+        raise ValueError(
+            f"--fused_conv applies to the v1 bottleneck resnets, not {name}")
     return spec.create(**kwargs), spec

@@ -82,6 +82,149 @@ class BasicBlock(nn.Module):
         return self.act(residual + y)
 
 
+def _bn_scale_shift(mdl, x, stats, momentum, epsilon, use_running_average):
+    """BatchNorm folded to per-channel scale/shift ``(a, b)``.
+
+    Creates the scale/bias params and running-stat variables ON ``mdl``
+    (so callers keep nn.BatchNorm's variable layout), derives batch
+    statistics from ``stats=(sum, sumsq)`` when given (the fused kernel's
+    epilogue) or by reducing ``x``, and updates the running averages in
+    train mode.  The ONE home of this logic for both fused-BN modules.
+    """
+    c = x.shape[-1]
+    scale = mdl.param("scale", nn.initializers.ones, (c,), jnp.float32)
+    bias = mdl.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+    ra_mean = mdl.variable("batch_stats", "mean",
+                           lambda s: jnp.zeros(s, jnp.float32), (c,))
+    ra_var = mdl.variable("batch_stats", "var",
+                          lambda s: jnp.ones(s, jnp.float32), (c,))
+    if use_running_average:
+        mean, var = ra_mean.value, ra_var.value
+    else:
+        if stats is None:
+            xf = x.astype(jnp.float32)
+            mean = xf.mean((0, 1, 2))
+            var = (xf * xf).mean((0, 1, 2)) - mean * mean
+        else:
+            s1, s2 = stats
+            n = x.shape[0] * x.shape[1] * x.shape[2]
+            mean = s1 / n
+            var = s2 / n - mean * mean
+        if not mdl.is_initializing():
+            ra_mean.value = (momentum * ra_mean.value
+                             + (1.0 - momentum) * mean)
+            ra_var.value = (momentum * ra_var.value
+                            + (1.0 - momentum) * var)
+    a = scale * jax.lax.rsqrt(var + epsilon)
+    b = bias - mean * a
+    return a, b
+
+
+class FusedBNReluConv3x3(nn.Module):
+    """BatchNorm(input) -> relu -> 3x3 conv as ONE Pallas pass.
+
+    Round-3 kernel (`ops/fused_conv.py`): at stage-2/3 shapes XLA does not
+    fuse the BN-apply+relu into the conv's input read (measured 35% slower
+    than the fused kernel, BASELINE.md round-3 table), so this module owns
+    the input's BN params/running stats AND the conv kernel and emits the
+    fused call where `fused_conv.eligible` says it wins; everywhere else
+    (strided blocks, stage-1/4 shapes, tiny test images) it emits the
+    identical XLA composition.  Returns ``(y, (sum, sumsq))`` — the
+    epilogue's per-channel stats of y, consumed by the NEXT BatchNorm so
+    no extra pass over y is ever made.
+    """
+
+    features: int
+    strides: int = 1
+    use_running_average: bool = False
+    dtype: Any = jnp.float32
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        from tpu_hc_bench.ops import fused_conv as fc
+
+        cin = x.shape[-1]
+        a, b = _bn_scale_shift(self, x, None, self.momentum, self.epsilon,
+                               self.use_running_average)
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (3, 3, cin, self.features), jnp.float32)
+        w = kernel.astype(self.dtype)
+        if fc.eligible(x.shape, (3, 3), self.strides, cin):
+            y, s1, s2 = fc.fused_bn_relu_conv(x, a, b, w)
+        else:
+            # same-dtype conv (like nn.Conv: MXU accumulates f32
+            # internally, output in compute dtype) — a f32-preferred
+            # output here would make autodiff transpose the conv with a
+            # f32 cotangent against bf16 operands, which lax rejects
+            xn = jnp.maximum(
+                x.astype(jnp.float32) * a + b, 0.0).astype(self.dtype)
+            y = jax.lax.conv_general_dilated(
+                xn, w, (self.strides, self.strides), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            yf = y.astype(jnp.float32)
+            s1 = yf.sum((0, 1, 2))
+            s2 = (yf * yf).sum((0, 1, 2))
+        return y, (s1, s2)
+
+
+class StatsBatchNorm(nn.Module):
+    """BatchNorm that consumes precomputed ``(sum, sumsq)`` stats (the
+    fused kernel's epilogue) instead of re-reducing its input; same
+    variable layout and running-stat semantics as ``nn.BatchNorm``."""
+
+    use_running_average: bool = False
+    dtype: Any = jnp.float32
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, stats=None):
+        a, b = _bn_scale_shift(self, x, stats, self.momentum, self.epsilon,
+                               self.use_running_average)
+        return (x.astype(jnp.float32) * a + b).astype(self.dtype)
+
+
+class FusedBottleneckBlock(nn.Module):
+    """BottleneckBlock with the BN1-relu-conv3x3 segment fused (Pallas)
+    and BN2 fed from the kernel's stats epilogue.  Same math as
+    ``BottleneckBlock`` (pinned by tests/test_fused_conv_model.py); the
+    param tree differs (the fused module owns bn1+conv2 jointly), so
+    checkpoints do not interchange with the unfused layout.
+    """
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    use_running_average: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y, st2 = FusedBNReluConv3x3(
+            self.filters, strides=self.strides,
+            use_running_average=self.use_running_average, dtype=self.dtype,
+        )(y)
+        y = self.act(StatsBatchNorm(
+            use_running_average=self.use_running_average, dtype=self.dtype,
+        )(y, stats=st2))
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="shortcut_conv",
+            )(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return self.act(residual + y)
+
+
 class PreactBottleneckBlock(nn.Module):
     """ResNet-v2 bottleneck (He 2016 full preactivation): BN-relu precede
     every conv, identity carries no norm/act.  tf_cnn_benchmarks exposes
@@ -121,6 +264,9 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.float32
     preact: bool = False                # v2: BN-relu inside blocks only
+    fused_conv: bool = False            # round 3: Pallas fused
+                                        # BN-relu-conv3x3 bottleneck segment
+                                        # (ops/fused_conv.py win region)
     space_to_depth: bool = False        # pack 2x2 blocks into channels and
                                         # run the stem as a 4x4/s1 conv — the
                                         # standard TPU stem transform (3-ch
@@ -180,15 +326,24 @@ class ResNet(nn.Module):
         if not self.preact:
             x = act(norm(name="bn_init")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls, extra = self.block_cls, {}
+        if self.fused_conv:
+            if self.block_cls is not BottleneckBlock:
+                raise ValueError(
+                    "fused_conv applies to the v1 bottleneck family "
+                    "(resnet50/101/152) only")
+            block_cls = FusedBottleneckBlock
+            extra = dict(use_running_average=not train, dtype=self.dtype)
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block_cls(
+                x = block_cls(
                     filters=self.num_filters * 2**i,
                     strides=strides,
                     conv=conv,
                     norm=norm,
                     act=act,
+                    **extra,
                 )(x)
         if self.preact:
             x = act(norm(name="bn_final")(x))
@@ -198,9 +353,11 @@ class ResNet(nn.Module):
 
 
 def _family(stages, block, preact=False):
-    def create(num_classes=1000, dtype=jnp.float32, space_to_depth=False):
+    def create(num_classes=1000, dtype=jnp.float32, space_to_depth=False,
+               fused_conv=False):
         return ResNet(stages, block, num_classes=num_classes, dtype=dtype,
-                      preact=preact, space_to_depth=space_to_depth)
+                      preact=preact, space_to_depth=space_to_depth,
+                      fused_conv=fused_conv)
     return create
 
 

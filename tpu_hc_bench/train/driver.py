@@ -378,8 +378,26 @@ def run_benchmark(
             "--sequence_parallel requires a device fabric (ici/dcn): the "
             "host path's shard_map would silently re-replicate the shards"
         )
+    # fabric=dcn selects the MULTISLICE layout: slices x hosts/slice x
+    # chips, a leading `dcn` mesh axis splitting the data dimension so the
+    # gradient allreduce's cross-slice phase is explicit (the reference's
+    # second-transport-stack role, run-tf-sing-libfabric-intelmpi.sh:86-105).
+    # Default: one slice per host (hosts without shared ICI); override
+    # with --num_slices for multi-host slices.
+    num_slices = 1
+    if fab is fabric_mod.Fabric.DCN:
+        num_slices = getattr(cfg, "num_slices", 0) or layout.num_hosts
+        if num_slices > 1 and mp > 1:
+            raise ValueError(
+                "fabric=dcn multislice currently composes with data "
+                "parallelism only")
+        if num_slices > 1 and cfg.eval:
+            raise ValueError("--eval under multislice dcn is not supported")
+    elif getattr(cfg, "num_slices", 0) > 1:
+        raise ValueError("--num_slices requires fabric=dcn")
     mesh = build_mesh(layout, model_parallel=max(tp, ep),
-                      pipeline_parallel=pp, sequence_parallel=sp)
+                      pipeline_parallel=pp, sequence_parallel=sp,
+                      num_slices=num_slices)
     # with TP/EP/PP/SP, the data-parallel degree (and so the global batch
     # at fixed per-worker batch) shrinks by the minor-axis product
     global_batch = layout.global_batch(cfg.batch_size) // mp
@@ -388,6 +406,7 @@ def run_benchmark(
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, attention_impl=cfg.attention_impl,
                                space_to_depth=cfg.use_space_to_depth,
+                               fused_conv=getattr(cfg, "fused_conv", False),
                                seq_len=cfg.seq_len,
                                gradient_checkpointing=cfg.gradient_checkpointing,
                                moe_impl=getattr(cfg, "moe_impl", "einsum"),
@@ -450,6 +469,13 @@ def run_benchmark(
         print_fn(line)
     fcfg = fabric_mod.FabricConfig(fab, cfg.fusion_threshold_bytes)
     print_fn(fcfg.summary())
+    if num_slices > 1:
+        per_slice = (f"{layout.num_hosts // num_slices} host(s)/slice"
+                     if num_slices <= layout.num_hosts
+                     else f"virtual slices on {layout.num_hosts} host(s)")
+        print_fn(
+            f"multislice: {num_slices} slices x {per_slice} — data axis = "
+            f"dcn({num_slices}) x data({layout.total_workers // num_slices})")
     print_fn(f"device_kind={hw.device_kind()} global_batch={global_batch}")
     for line in hw.ici_topology_lines():
         print_fn(line)
@@ -485,6 +511,37 @@ def run_benchmark(
 
                 for b in itertools.chain([batch], host_iter):
                     yield step_mod.shard_batch(b, mesh)
+            yield from _prefetch(raw())
+    elif spec.is_text and cfg.data_dir is not None:
+        # real pre-tokenized corpus (<data_dir>/<split>.bin memmap) — the
+        # reference's real-data axis for the text members (round 3)
+        from tpu_hc_bench.data.tokens import TokenDataset, _resolve
+        from jax.sharding import PartitionSpec as P
+
+        seq_len = spec.input_shape[0]
+        split = "train"
+        if cfg.eval:
+            try:
+                _resolve(cfg.data_dir, "validation")
+                split = "validation"
+            except FileNotFoundError:
+                pass
+        ds = TokenDataset(
+            cfg.data_dir, global_batch, seq_len, split=split,
+            causal_lm=spec.causal_lm,
+            worker=jax.process_index(), num_workers=jax.process_count(),
+            seed=cfg.seed, vocab_size=spec.vocab_size,
+        )
+        host_iter = iter(ds)
+        batch = next(host_iter)
+        batch_spec = P(DATA_AXIS, SEQ_AXIS) if sp > 1 else None
+
+        def batches():
+            def raw():
+                import itertools
+
+                for b in itertools.chain([batch], host_iter):
+                    yield step_mod.shard_batch(b, mesh, batch_spec)
             yield from _prefetch(raw())
     elif spec.is_text:
         seq_len = spec.input_shape[0]
