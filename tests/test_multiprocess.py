@@ -135,6 +135,48 @@ TP_WORKER = textwrap.dedent("""
 """)
 
 
+DCN_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from tpu_hc_bench.parallel import distributed
+    from tpu_hc_bench import topology
+
+    port = int(sys.argv[1])
+    distributed.initialize(coordinator_port=port)
+    assert jax.process_count() == 2 and jax.device_count() == 4
+
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.data.synthetic import SyntheticImages
+    from tpu_hc_bench.models import create_model
+    from tpu_hc_bench.train import step as step_mod
+
+    layout = topology.discover_layout(workers_per_host=0)
+    # MULTISLICE: each process is one slice; the dcn axis IS the process
+    # boundary, the data axis stays inside each process ("slice ICI")
+    mesh = topology.build_mesh(layout, num_slices=2)
+    assert mesh.axis_names[:2] == (topology.DCN_AXIS, topology.DATA_AXIS)
+    assert mesh.shape[topology.DCN_AXIS] == 2
+    for dev in mesh.devices[0].ravel():
+        assert dev.process_index == 0   # slice 0 == process 0: boundary real
+    cfg = flags.BenchmarkConfig(model="trivial", num_classes=10,
+                                batch_size=1).resolve()
+    model, spec = create_model("trivial", num_classes=10)
+    batch = SyntheticImages(4, (8, 8, 3), num_classes=10).batch()
+    state = step_mod.make_train_state(model, cfg, batch)
+    state = step_mod.replicate_state(state, mesh)
+    train_step = step_mod.build_train_step(mesh, cfg, spec)
+    state, metrics = train_step(state, step_mod.shard_batch(batch, mesh),
+                                jax.random.PRNGKey(0))
+    loss = float(jax.device_get(metrics["loss"]))
+    assert loss == loss, "multislice loss is NaN"
+    print(f"MP_DCN_OK process={jax.process_index()} loss={loss:.4f}",
+          flush=True)
+""")
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -200,6 +242,12 @@ def test_two_process_pipeline_step(tmp_path):
     """DP x PP across 2 processes: pipe hops intra-process, the data-axis
     gradient psum crosses the process boundary (the DCN analog)."""
     _run_two_workers(tmp_path, PP_WORKER, "MP_PP_OK")
+
+
+def test_two_process_multislice_step(tmp_path):
+    """fabric=dcn's layout across 2 REAL processes: the dcn axis is the
+    process boundary, gradients reduce hierarchically over (dcn, data)."""
+    _run_two_workers(tmp_path, DCN_WORKER, "MP_DCN_OK")
 
 
 def test_two_process_tensor_parallel_step(tmp_path):

@@ -1,0 +1,81 @@
+"""Multislice (fabric=dcn) mechanism: mesh shape, step parity, guards.
+
+Round-3 (VERDICT #6): ``fabric=dcn`` now selects a real layout — a
+leading ``dcn`` mesh axis splitting the data dimension — instead of only
+printing a different banner.  The cross-PROCESS form lives in
+tests/test_multiprocess.py::test_two_process_multislice_step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hc_bench import flags, topology
+from tpu_hc_bench.train import driver
+
+
+def test_multislice_mesh_shape(devices):
+    layout = topology.compute_layout(1, 8, 8)
+    mesh = topology.build_mesh(layout, num_slices=2)
+    assert mesh.axis_names[:2] == (topology.DCN_AXIS, topology.DATA_AXIS)
+    assert mesh.shape[topology.DCN_AXIS] == 2
+    assert mesh.shape[topology.DATA_AXIS] == 4
+
+    with pytest.raises(ValueError, match="num_slices"):
+        topology.build_mesh(layout, num_slices=3)   # 8 % 3
+    # on a multi-host layout, slices must be contiguous host groups
+    with pytest.raises(ValueError, match="does not divide"):
+        topology.build_mesh(topology.compute_layout(2, 0, 4), num_slices=3)
+
+
+def _run(fabric, **kw):
+    cfg = flags.BenchmarkConfig(
+        model="trivial", num_classes=10, batch_size=2,
+        num_warmup_batches=1, num_batches=3, display_every=1, **kw,
+    ).resolve()
+    out = []
+    res = driver.run_benchmark(cfg, fabric_name=fabric, print_fn=out.append)
+    return res, "\n".join(out)
+
+
+def test_dcn_driver_matches_ici(mesh8):
+    """fabric=dcn with 2 virtual slices trains and reaches the same loss
+    as the plain ICI run (same global batch, same math — the hierarchical
+    (dcn, data) reduction must equal the flat data reduction)."""
+    res_ici, _ = _run("ici")
+    res_dcn, text = _run("dcn", num_slices=2)
+    assert "multislice: 2 slices" in text
+    assert "dcn(2) x data(4)" in text
+    np.testing.assert_allclose(res_dcn.final_loss, res_ici.final_loss,
+                               rtol=1e-5)
+
+
+def test_dcn_gspmd_arm_matches(mesh8):
+    """--variable_update=replicated keeps its GSPMD arm under multislice
+    (batch sharded over (dcn, data); XLA inserts the hierarchical
+    reduction itself)."""
+    res_ici, _ = _run("ici", variable_update="replicated")
+    res_dcn, text = _run("dcn", num_slices=2, variable_update="replicated")
+    assert "multislice: 2 slices" in text
+    np.testing.assert_allclose(res_dcn.final_loss, res_ici.final_loss,
+                               rtol=1e-5)
+
+
+def test_dcn_guards(mesh8):
+    with pytest.raises(ValueError, match="requires fabric=dcn"):
+        _run("ici", num_slices=2)
+    with pytest.raises(ValueError, match="data parallelism only"):
+        _run("dcn", num_slices=2, model_parallel=2)
+    cfg = flags.BenchmarkConfig(
+        model="trivial", num_classes=10, batch_size=2, eval=True,
+        num_batches=2, num_slices=2).resolve()
+    with pytest.raises(ValueError, match="not supported"):
+        driver.run_benchmark(cfg, fabric_name="dcn", print_fn=lambda _: None)
+
+
+def test_dcn_single_host_degenerates(mesh8):
+    """One host => one slice: dcn behaves as before (banner, same mesh)."""
+    res, text = _run("dcn")
+    assert "multislice" not in text
+    assert np.isfinite(res.final_loss)

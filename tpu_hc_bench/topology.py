@@ -37,6 +37,12 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
+# Multislice: the slice-crossing data-parallel axis.  Collectives over it
+# ride DCN (slices have no ICI between them); the step builders reduce
+# gradients over (dcn, data) so XLA emits the hierarchical allreduce —
+# reduce-scatter within a slice over ICI, the small cross-slice exchange
+# over DCN (the round-3 `fabric=dcn` mechanism).
+DCN_AXIS = "dcn"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,6 +151,7 @@ def build_mesh(
     model_parallel: int = 1,
     pipeline_parallel: int = 1,
     sequence_parallel: int = 1,
+    num_slices: int = 1,
 ) -> Mesh:
     """Build the device mesh for this layout.
 
@@ -166,6 +173,15 @@ def build_mesh(
     so intra-host ICI carries the short allreduce hops and DCN only the
     inter-host phase (the `ib` fast path of run-tf-sing-ucx-openmpi.sh:85-92
     by construction).
+
+    ``num_slices > 1`` is the explicit **multislice** layout
+    (slices x hosts/slice x chips): a leading ``dcn`` axis of that size
+    splits the data dimension, contiguous host groups form the slices
+    (host-major order makes slice-major equal host-major), and the step
+    builders reduce over ``(dcn, data)`` so the cross-slice phase of the
+    gradient allreduce is explicit in the program — the round-3 mechanism
+    behind ``fabric=dcn`` (the reference's second transport stack,
+    run-tf-sing-libfabric-intelmpi.sh:86-105, as a mesh axis).
     """
     import numpy as np
 
@@ -186,6 +202,25 @@ def build_mesh(
             f"{prod} ({'x'.join(f'{nm}={d}' for nm, d in active)})")
     if not active:
         active = [(MODEL_AXIS, 1)]      # preserve the 2-D DP mesh shape
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if num_slices > 1:
+        # real pods: contiguous host groups form slices; a single-host
+        # (virtual) mesh may still split into slices for testing
+        if layout.num_hosts > 1 and layout.num_hosts % num_slices:
+            raise ValueError(
+                f"num_slices={num_slices} does not divide "
+                f"num_hosts={layout.num_hosts}")
+        data = n // prod
+        if data % num_slices:
+            raise ValueError(
+                f"data degree {data} not divisible by num_slices="
+                f"{num_slices}")
+        shape = (num_slices, data // num_slices) + tuple(
+            deg for _, deg in active)
+        arr = np.array(picked, dtype=object).reshape(shape)
+        return Mesh(arr, (DCN_AXIS, DATA_AXIS)
+                    + tuple(name for name, _ in active))
     shape = (n // prod,) + tuple(deg for _, deg in active)
     arr = np.array(picked, dtype=object).reshape(shape)
     return Mesh(arr, (DATA_AXIS,) + tuple(name for name, _ in active))

@@ -200,6 +200,16 @@ def build_train_step(
     sp = getattr(cfg, "sequence_parallel", 1) > 1
     tp = getattr(cfg, "model_parallel", 1) > 1
 
+    from tpu_hc_bench.topology import DCN_AXIS
+
+    dcn = DCN_AXIS in mesh.axis_names
+    if dcn and (sp or tp or getattr(cfg, "expert_parallel", 1) > 1):
+        raise ValueError(
+            "multislice (dcn) currently composes with data parallelism "
+            "only")
+    if dcn and fab is fabric_mod.Fabric.HOST:
+        raise ValueError("fabric=host has no multislice layout")
+
     if fab is fabric_mod.Fabric.HOST:
         return _build_host_step(mesh, cfg, is_text)
     if not sp and (tp or getattr(cfg, "expert_parallel", 1) > 1):
@@ -207,7 +217,7 @@ def build_train_step(
         # tp_param_spec shardings and jit follows them
         return _build_gspmd_step(mesh, cfg, is_text, follow_inputs=True)
     if not sp and cfg.variable_update == "replicated":
-        return _build_gspmd_step(mesh, cfg, is_text)
+        return _build_gspmd_step(mesh, cfg, is_text, dcn=dcn)
 
     # --sequence_parallel: same explicit-psum step over a (data, seq) mesh
     # — batch sharded over both axes, gradients reduced (with the same
@@ -219,7 +229,11 @@ def build_train_step(
     # body, inserting the Megatron all-reduces itself.
     from tpu_hc_bench.topology import SEQ_AXIS
 
+    # multislice: gradients reduce over (dcn, data) — XLA emits the
+    # hierarchical allreduce with the cross-slice phase on DCN
     axes = (DATA_AXIS, SEQ_AXIS) if sp else (DATA_AXIS,)
+    if dcn:
+        axes = (DCN_AXIS,) + axes
     if sp and tp:
         # fusion buckets concatenate grad tensors, which would force
         # all-gathers of the model-sharded grads under the auto axis —
@@ -276,7 +290,9 @@ def build_train_step(
         device_step = fwd_only
 
     replicated = P()
-    sharded = P(*axes)
+    # dcn+data both split the leading batch dim (one tuple group); the SP
+    # pair splits batch dim 0 (data) and seq dim 1 separately
+    sharded = P((DCN_AXIS, DATA_AXIS)) if dcn else P(*axes)
     manual: dict = {}
     if sp and tp:
         # partial-manual shard_map: data/seq manual, model auto (GSPMD)
@@ -298,7 +314,7 @@ def build_train_step(
 
 
 def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
-                      follow_inputs: bool = False):
+                      follow_inputs: bool = False, dcn: bool = False):
     """``--variable_update=replicated``: the pure-GSPMD arm.
 
     No shard_map, no explicit collectives: the step is written over the
@@ -342,8 +358,11 @@ def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
         # TP: inputs arrive committed (shard_state_tp / shard_batch); jit
         # follows those shardings and GSPMD inserts the TP collectives
         return jax.jit(step_fn, donate_argnums=(0,))
+    from tpu_hc_bench.topology import DCN_AXIS
+
     repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P(DATA_AXIS))
+    data = NamedSharding(
+        mesh, P((DCN_AXIS, DATA_AXIS)) if dcn else P(DATA_AXIS))
     return jax.jit(
         step_fn,
         in_shardings=(repl, data, repl),
@@ -601,6 +620,12 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
 
 def shard_batch(batch: tuple, mesh: Mesh, spec: P | None = None) -> tuple:
     """Place a global host batch sharded over the data axis (or ``spec`` —
-    e.g. ``P(DATA_AXIS, SEQ_AXIS)`` for sequence-parallel token batches)."""
-    sharding = NamedSharding(mesh, P(DATA_AXIS) if spec is None else spec)
+    e.g. ``P(DATA_AXIS, SEQ_AXIS)`` for sequence-parallel token batches).
+    On a multislice mesh the batch dim splits over BOTH (dcn, data)."""
+    from tpu_hc_bench.topology import DCN_AXIS
+
+    if spec is None:
+        spec = (P((DCN_AXIS, DATA_AXIS))
+                if DCN_AXIS in mesh.axis_names else P(DATA_AXIS))
+    sharding = NamedSharding(mesh, spec)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
