@@ -28,11 +28,14 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 
 WORKDIR /opt/tpu-hc-bench
 
-# the pinned stack (install_jax_stack.sh's version lock, container flavor);
+# the pinned stack — scripts/setup/stack-pins.txt is the ONE source of
+# truth shared with install_jax_stack.sh (host) and build-venv-image.sh,
+# so the image can never drift from the host stack (the reference's
+# %post-reruns-setup.sh double-build serves exactly this purpose);
 # [tpu] extras pull libtpu for real hardware — harmless on CPU-only hosts
-COPY pyproject.toml .
-RUN pip install --no-cache-dir \
-        "jax[tpu]==0.9.0" flax optax chex einops orbax-checkpoint pillow \
+COPY pyproject.toml scripts/setup/stack-pins.txt ./
+RUN PIN_JAX="$(grep -oP '^jax==\K.*' stack-pins.txt)" \
+    && pip install --no-cache-dir "jax[tpu]==${PIN_JAX}" -r stack-pins.txt \
         -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
 
 COPY tpu_hc_bench/ tpu_hc_bench/

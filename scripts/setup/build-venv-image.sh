@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# Build the runtime image as a relocatable venv tarball — the degraded-
+# but-runnable form of the reference's L2 contract (build once, sanity-run,
+# exec everywhere: /root/reference/install-scripts/build-container.sh:23-30)
+# for hosts without a container runtime.  The Dockerfile encodes the same
+# contract for hosts WITH one; both consume scripts/setup/stack-pins.txt
+# so the image can never drift from the host stack.
+#
+#   usage: ./build-venv-image.sh [out_dir]        (default ./build/venv-image)
+#
+# Produces:
+#   <out_dir>/tpu-hc-bench-venv.tar.gz       the image
+#   <out_dir>/build.log                      full build transcript
+#   <out_dir>/sanity.txt                     the image's OWN sanity report
+#                                            (the `singularity run` analog —
+#                                            a failing report fails the build)
+#
+# Assembly strategy, in order:
+#   1. online:  pip install the pinned set from PyPI into a fresh venv
+#   2. offline: VERIFY the live interpreter's packages match the pins
+#      exactly, then clone them into the fresh venv (same artifact, with
+#      provenance recorded in build.log) — this is the path on air-gapped
+#      boxes like this dev environment.
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(cd "$HERE/../.." && pwd)"
+OUT="${1:-$REPO/build/venv-image}"
+PINS="$HERE/stack-pins.txt"
+VENV="$OUT/venv"
+
+mkdir -p "$OUT"
+exec > >(tee "$OUT/build.log") 2>&1
+echo "== build-venv-image $(date -u +%Y-%m-%dT%H:%M:%SZ) =="
+echo "pins: $PINS"
+
+rm -rf "$VENV"
+python -m venv --copies "$VENV"
+
+PIN_JAX="$(grep -oP '^jax==\K.*' "$PINS")"
+if pip download --no-deps --dest "$OUT/probe" "jax==${PIN_JAX}" \
+        >/dev/null 2>&1; then
+    echo "mode: online (PyPI)"
+    # jax[tpu] + the libtpu wheel index, exactly like install_jax_stack.sh
+    # and the Dockerfile — the image must be able to drive a TPU
+    "$VENV/bin/pip" install --no-cache-dir "jax[tpu]==${PIN_JAX}" \
+        -r "$PINS" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+else
+    echo "mode: offline — cloning the live stack after verifying the pins"
+    python - "$PINS" <<'EOF'
+import importlib.metadata as md, sys
+pins = {}
+for line in open(sys.argv[1]):
+    line = line.split("#")[0].strip()
+    if line:
+        name, ver = line.split("==")
+        pins[name] = ver
+bad = []
+for name, want in pins.items():
+    try:
+        have = md.version(name)
+    except md.PackageNotFoundError:
+        bad.append(f"{name}: MISSING (pin {want})"); continue
+    if have != want:
+        bad.append(f"{name}: {have} != pin {want}")
+if bad:
+    print("live stack does NOT match stack-pins.txt:\n  " + "\n  ".join(bad))
+    sys.exit(1)
+print("live stack matches stack-pins.txt exactly "
+      f"({len(pins)} pins verified)")
+EOF
+    SRC_SITE="$(python -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])')"
+    DST_SITE="$("$VENV/bin/python" -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])')"
+    echo "cloning $SRC_SITE -> $DST_SITE"
+    cp -a "$SRC_SITE/." "$DST_SITE/"
+fi
+
+echo "installing tpu_hc_bench into the image"
+cp -a "$REPO/tpu_hc_bench" \
+    "$("$VENV/bin/python" -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])')/"
+
+echo "building the native data plane inside the image"
+make -C "$("$VENV/bin/python" -c 'import sysconfig; print(sysconfig.get_paths()["purelib"])')/tpu_hc_bench/native"
+
+# --- the sanity gate (build-container.sh:29-30's `singularity run`) ---
+echo "running the image sanity report"
+JAX_PLATFORMS=cpu "$VENV/bin/python" -m tpu_hc_bench.utils.sanity \
+    | tee "$OUT/sanity.txt"
+
+echo "packing"
+# gzip -1: the stack is ~6 GB of already-compressed wheels content; fast
+# compression keeps the pack step minutes, not tens of minutes, on 1 vCPU
+tar -C "$OUT" -c venv | gzip -1 > "$OUT/tpu-hc-bench-venv.tar.gz"
+SIZE=$(du -h "$OUT/tpu-hc-bench-venv.tar.gz" | cut -f1)
+SHA=$(sha256sum "$OUT/tpu-hc-bench-venv.tar.gz" | cut -d' ' -f1)
+echo "image: $OUT/tpu-hc-bench-venv.tar.gz ($SIZE, sha256 $SHA)"
+echo "unpack anywhere and run: venv/bin/python -m tpu_hc_bench ..."
+echo "== build OK =="

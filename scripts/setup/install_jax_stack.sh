@@ -10,7 +10,9 @@
 set -euo pipefail
 
 CHANNEL="${1:-stable}"
-PIN_JAX="0.9.0"   # known-good pin, the UCX-1.5.0-style version lock
+# the version lock lives in ONE place (stack-pins.txt) shared with the
+# Dockerfile and build-venv-image.sh, so host and image cannot drift
+PINS="$(cd "$(dirname "$0")" && pwd)/stack-pins.txt"
 
 if python - <<'EOF'
 import sys
@@ -32,7 +34,8 @@ fi
 
 case "$CHANNEL" in
     stable)
-        pip install "jax[tpu]==${PIN_JAX}" flax optax chex einops \
+        PIN_JAX="$(grep -oP '^jax==\K.*' "$PINS")"
+        pip install "jax[tpu]==${PIN_JAX}" -r "$PINS" \
             -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
         ;;
     nightly)

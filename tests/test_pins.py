@@ -1,0 +1,46 @@
+"""stack-pins.txt is the single source of truth for every build surface."""
+
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PINS = REPO / "scripts/setup/stack-pins.txt"
+
+
+def _pins() -> dict[str, str]:
+    out = {}
+    for line in PINS.read_text().splitlines():
+        line = line.split("#")[0].strip()
+        if line:
+            name, ver = line.split("==")
+            out[name] = ver
+    return out
+
+
+def test_pins_cover_the_stack():
+    pins = _pins()
+    for pkg in ("jax", "flax", "optax", "chex", "einops",
+                "orbax-checkpoint", "numpy", "pillow"):
+        assert pkg in pins, f"{pkg} missing from stack-pins.txt"
+        assert pins[pkg][0].isdigit()
+
+
+def test_all_build_surfaces_consume_the_pins():
+    # host installer, container image, and venv image all read ONE file
+    assert "stack-pins.txt" in (REPO / "scripts/setup/install_jax_stack.sh"
+                                ).read_text()
+    assert "stack-pins.txt" in (REPO / "Dockerfile").read_text()
+    assert "stack-pins.txt" in (REPO / "scripts/setup/build-venv-image.sh"
+                                ).read_text()
+    # no stray hardcoded jax pin left in the Dockerfile
+    assert "jax[tpu]==0" not in (REPO / "Dockerfile").read_text()
+
+
+def test_pins_match_live_env_when_present():
+    import importlib.metadata as md
+
+    for name, want in _pins().items():
+        try:
+            have = md.version(name)
+        except md.PackageNotFoundError:
+            continue
+        assert have == want, f"{name}: live {have} != pin {want}"
