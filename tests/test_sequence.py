@@ -209,3 +209,56 @@ def test_gpt_forward_seq_parallel_matches_dense(devices):
     out = fn(variables, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_degenerate_sp_single_chip(mesh8):
+    """Round 3 (VERDICT #9): a seq-sharded attention impl at
+    --sequence_parallel=1 runs on a size-1 seq axis — world-1 collectives
+    — and must match the plain flash/dense run's loss (same math, so the
+    hardware row measures pure SP-machinery overhead)."""
+    from tpu_hc_bench import flags as fl
+    from tpu_hc_bench.train import driver as drv
+
+    def run(impl, sp=1):
+        cfg = fl.BenchmarkConfig(
+            model="bert_tiny", batch_size=1, num_warmup_batches=1,
+            num_batches=2, display_every=1, attention_impl=impl,
+            sequence_parallel=sp).resolve()
+        out = []
+        res = drv.run_benchmark(cfg, print_fn=out.append)
+        return res, "\n".join(out)
+
+    res_dense, _ = run("dense")
+    res_ring, text = run("ring")
+    assert "1 shards x 64 tokens/shard" in text
+    # same params/data; the SP step folds dropout keys over the extra
+    # (size-1) seq axis so the masks differ — losses agree to ~1%, and the
+    # bitwise attention parity is pinned by the sp=2/4 tests above
+    np.testing.assert_allclose(res_ring.final_loss, res_dense.final_loss,
+                               rtol=5e-2)
+    res_uf, _ = run("ulysses_flash")
+    np.testing.assert_allclose(res_uf.final_loss, res_dense.final_loss,
+                               rtol=5e-2)
+
+
+def test_degenerate_sp_composes_with_dp_only():
+    """The degenerate seq axis is keyed on sequence_parallel>1 nowhere, so
+    PP/EP/TP under it would silently misconfigure — rejected at resolve."""
+    from tpu_hc_bench import flags as fl
+
+    for kw in (dict(pipeline_parallel=2), dict(expert_parallel=2),
+               dict(model_parallel=2)):
+        with pytest.raises(ValueError, match="plain data parallelism"):
+            fl.BenchmarkConfig(attention_impl="ring", **kw).resolve()
+    # host fabric binds no seq axis
+    from tpu_hc_bench.train import driver as drv
+
+    cfg = fl.BenchmarkConfig(model="bert_tiny", batch_size=1,
+                             attention_impl="ring").resolve()
+    with pytest.raises(ValueError, match="device fabric"):
+        drv.run_benchmark(cfg, fabric_name="sock", print_fn=lambda _: None)
+    # the replicated->psum translation is in the audit trail
+    cfg = fl.BenchmarkConfig(attention_impl="ring",
+                             variable_update="replicated").resolve()
+    assert cfg.variable_update == "psum"
+    assert any("replicated->psum" in l for l in cfg.summary_lines())

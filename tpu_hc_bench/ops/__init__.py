@@ -1,10 +1,17 @@
 """Hand-written TPU kernels (Pallas) for ops where stock XLA underperforms.
 
 The reference delegates all kernels to MKL-DNN (SURVEY.md §2b #21); this
-framework delegates to XLA:TPU and drops to Pallas only where fusion
-opportunities exceed what the compiler does — currently the large-vocab
-softmax cross-entropy of the BERT MLM head (``ops.xent``).
+framework delegates to XLA:TPU and drops to Pallas only where measurement
+shows a win.  The record (BASELINE.md):
+
+- ``flash_attention`` — WINS from seq 512 up (50x at seq 8k): the
+  production long-context path.
+- ``xent`` — demoted: slower-or-parity at every measured vocab/seq
+  (bert/gpt2/llama); kept as an experimental knob.
+- ``fused_conv`` — whole-model parity (isolated-segment wins don't
+  transfer); kept flag-gated as the recorded measurement apparatus.
 """
 
 from tpu_hc_bench.ops.flash_attention import flash_attention  # noqa: F401
+from tpu_hc_bench.ops.fused_conv import fused_bn_relu_conv  # noqa: F401
 from tpu_hc_bench.ops.xent import softmax_xent, softmax_xent_reference  # noqa: F401

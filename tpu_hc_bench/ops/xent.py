@@ -16,6 +16,21 @@ row logsumexp — again one read of logits, one write of dlogits.
 
 On non-TPU backends the same kernel runs in Pallas interpreter mode (how
 the unit tests exercise it on the virtual CPU mesh).
+
+**STATUS (round 3): DEMOTED — measured slower-or-parity at every config.**
+The win-or-retire measurement VERDICT #2 demanded (BASELINE.md "fused
+xent, the full record"):
+
+    bert_base  30k vocab, seq 128,  bs=128:  886 vs 1059 ex/s  (0.84x)
+    gpt2       50k vocab, seq 1024 (flash):  82.3 vs 99.9 ex/s (0.82x)
+    llama_1b   32k vocab, seq 2048, bs=2:    drift-paired median 0.990x
+
+Even in its motivating regime (0.5 GB/step of f32 logits on llama_1b)
+XLA's own softmax-xent fusion matches the hand kernel — consistent with
+the round-3 fused-conv finding that XLA is at its fused bound in-model.
+``--fused_xent`` stays as an EXPERIMENTAL knob (the kernel is correct and
+unit-tested; no ``auto`` heuristic exists because there is no winning
+region to select).
 """
 
 from __future__ import annotations

@@ -152,6 +152,7 @@ def build_mesh(
     pipeline_parallel: int = 1,
     sequence_parallel: int = 1,
     num_slices: int = 1,
+    force_seq_axis: bool = False,
 ) -> Mesh:
     """Build the device mesh for this layout.
 
@@ -190,7 +191,10 @@ def build_mesh(
     for name, deg in minors:
         if deg < 1:
             raise ValueError(f"{name} degree must be >= 1, got {deg}")
-    active = [(name, deg) for name, deg in minors if deg > 1]
+    # force_seq_axis: keep a size-1 seq axis (degenerate SP — the
+    # seq-sharded attention impls need the axis name bound even at world 1)
+    active = [(name, deg) for name, deg in minors
+              if deg > 1 or (name == SEQ_AXIS and force_seq_axis)]
     picked = select_devices(layout, devices)
     n = len(picked)
     prod = 1

@@ -352,6 +352,10 @@ def run_benchmark(
     # pairings are rejected explicitly.
     pp = max(1, getattr(cfg, "pipeline_parallel", 1))
     sp = max(1, getattr(cfg, "sequence_parallel", 1))
+    # degenerate SP (round 3): a seq-sharded attention impl at
+    # sequence_parallel=1 runs on a size-1 seq axis (world-1 collectives)
+    sp_active = sp > 1 or cfg.attention_impl in (
+        "ring", "ulysses", "ulysses_flash")
     tp = max(1, cfg.model_parallel)
     ep = max(1, getattr(cfg, "expert_parallel", 1))
     if tp > 1 and ep > 1:
@@ -372,11 +376,13 @@ def run_benchmark(
             f"--sequence_parallel product {mp} does not divide "
             f"{layout.total_workers} workers"
         )
-    if mp > 1 and fab is fabric_mod.Fabric.HOST:
+    if (mp > 1 or sp_active) and fab is fabric_mod.Fabric.HOST:
         raise ValueError(
             "--model_parallel/--expert_parallel/--pipeline_parallel/"
-            "--sequence_parallel requires a device fabric (ici/dcn): the "
-            "host path's shard_map would silently re-replicate the shards"
+            "--sequence_parallel (incl. the degenerate seq axis of the "
+            "seq-sharded attention impls) requires a device fabric "
+            "(ici/dcn): the host path's shard_map binds no seq axis and "
+            "would silently re-replicate the shards"
         )
     # fabric=dcn selects the MULTISLICE layout: slices x hosts/slice x
     # chips, a leading `dcn` mesh axis splitting the data dimension so the
@@ -397,7 +403,7 @@ def run_benchmark(
         raise ValueError("--num_slices requires fabric=dcn")
     mesh = build_mesh(layout, model_parallel=max(tp, ep),
                       pipeline_parallel=pp, sequence_parallel=sp,
-                      num_slices=num_slices)
+                      num_slices=num_slices, force_seq_axis=sp_active)
     # with TP/EP/PP/SP, the data-parallel degree (and so the global batch
     # at fixed per-worker batch) shrinks by the minor-axis product
     global_batch = layout.global_batch(cfg.batch_size) // mp
@@ -412,8 +418,8 @@ def run_benchmark(
                                moe_impl=getattr(cfg, "moe_impl", "einsum"),
                                moe_capacity_factor=getattr(
                                    cfg, "moe_capacity_factor", 1.25),
-                               seq_axis=SEQ_AXIS if sp > 1 else None)
-    if sp > 1:
+                               seq_axis=SEQ_AXIS if sp_active else None)
+    if sp_active:
         seq_len = spec.input_shape[0]
         if seq_len % sp:
             raise ValueError(
@@ -534,7 +540,7 @@ def run_benchmark(
         )
         host_iter = iter(ds)
         batch = next(host_iter)
-        batch_spec = P(DATA_AXIS, SEQ_AXIS) if sp > 1 else None
+        batch_spec = P(DATA_AXIS, SEQ_AXIS) if sp_active else None
 
         def batches():
             def raw():
@@ -552,7 +558,7 @@ def run_benchmark(
         from jax.sharding import PartitionSpec as P
 
         # under SP the [B, S] token batch shards over BOTH mesh axes
-        batch_spec = P(DATA_AXIS, SEQ_AXIS) if sp > 1 else None
+        batch_spec = P(DATA_AXIS, SEQ_AXIS) if sp_active else None
 
         def batches():
             dev_batch = step_mod.shard_batch(batch, mesh, batch_spec)
@@ -572,7 +578,7 @@ def run_benchmark(
 
     # --- state + step ---
     pp_save_ctx = None     # (model, template) when PP saves need restacking
-    if sp > 1:
+    if sp_active:
         print_fn(f"sequence parallel: {sp} shards x "
                  f"{spec.input_shape[0] // sp} tokens/shard "
                  f"({cfg.attention_impl})")

@@ -197,10 +197,13 @@ def build_train_step(
     """
     is_text = spec.is_text
     fuse = cfg.variable_update == "psum"
-    sp = getattr(cfg, "sequence_parallel", 1) > 1
-    tp = getattr(cfg, "model_parallel", 1) > 1
+    from tpu_hc_bench.topology import DCN_AXIS, SEQ_AXIS as _SEQ
 
-    from tpu_hc_bench.topology import DCN_AXIS
+    # a bound seq axis (any size — size 1 is the degenerate-SP mode)
+    # routes through the (data, seq) shard_map arm
+    sp = (getattr(cfg, "sequence_parallel", 1) > 1
+          or _SEQ in mesh.axis_names)
+    tp = getattr(cfg, "model_parallel", 1) > 1
 
     dcn = DCN_AXIS in mesh.axis_names
     if dcn and (sp or tp or getattr(cfg, "expert_parallel", 1) > 1):
