@@ -177,13 +177,53 @@ DCN_WORKER = textwrap.dedent("""
 """)
 
 
+CKPT_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from tpu_hc_bench.parallel import distributed
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.train import driver
+
+    port = int(sys.argv[1])
+    train_dir = sys.argv[2]      # the shared filesystem (same box)
+    distributed.initialize(coordinator_port=port)
+    assert jax.process_count() == 2
+
+    def run():
+        cfg = flags.BenchmarkConfig(
+            model="trivial", num_classes=10, batch_size=1,
+            num_warmup_batches=1, num_batches=2, display_every=1,
+            train_dir=train_dir).resolve()
+        out = []
+        driver.run_benchmark(cfg, print_fn=out.append)
+        return "\\n".join(out)
+
+    text = run()
+    assert "filesystem shared by all hosts" in text
+    if jax.process_index() == 0:
+        assert "checkpoint saved" in text
+    # barrier: process 1 must not start the resume run before process
+    # 0's save lands (between-RUNS ordering is the operator's job on a
+    # real pod; inside one program we sync explicitly)
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("ckpt_written")
+    # second run resumes from the shared checkpoint on BOTH processes
+    text = run()
+    assert "restored checkpoint step 3" in text, text
+    print(f"MP_CKPT_OK process={jax.process_index()}", flush=True)
+""")
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-def _run_two_workers(tmp_path, worker_src, ok_marker):
+def _run_two_workers(tmp_path, worker_src, ok_marker, extra_args=()):
     hostfile = tmp_path / "nodeips.txt"
     hostfile.write_text("127.0.0.1\n127.0.0.1\n")
     script = tmp_path / "worker.py"
@@ -200,7 +240,7 @@ def _run_two_workers(tmp_path, worker_src, ok_marker):
             "JAX_PLATFORMS": "cpu",
         })
         procs.append(subprocess.Popen(
-            [sys.executable, str(script), str(port)],
+            [sys.executable, str(script), str(port), *map(str, extra_args)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         ))
@@ -242,6 +282,14 @@ def test_two_process_pipeline_step(tmp_path):
     """DP x PP across 2 processes: pipe hops intra-process, the data-axis
     gradient psum crosses the process boundary (the DCN analog)."""
     _run_two_workers(tmp_path, PP_WORKER, "MP_PP_OK")
+
+
+def test_two_process_checkpoint_roundtrip(tmp_path):
+    """--train_dir across 2 real processes: process 0 writes the
+    replicated-DP checkpoint, BOTH processes resume from the shared
+    filesystem (round 3: the multi-process checkpoint policy)."""
+    _run_two_workers(tmp_path, CKPT_WORKER, "MP_CKPT_OK",
+                     extra_args=[tmp_path / "shared_ckpt"])
 
 
 def test_two_process_multislice_step(tmp_path):

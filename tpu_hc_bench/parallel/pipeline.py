@@ -224,29 +224,12 @@ def _opt_specs(opt_state, param_specs: dict, params: dict):
                            otherwise=lambda _: P())
 
 
-def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
-                        example_params: dict, example_opt_state,
-                        deterministic: bool = False, tp: bool = False):
-    """DP x PP training step for any decoder exposing the PP interface.
-
-    ``model`` implements ``pp_embed`` / ``pp_layer_module`` / ``pp_head``
-    (GPTLM and LlamaLM today) and its params have been restacked with
-    ``stack_layer_params``.  The stage forward is DERIVED from those
-    methods — no per-family wiring lives here.  The step is a
-    ``shard_map`` over the ``(data, pipe)`` mesh: batch sharded over
-    data, trunk sharded over pipe, embed/head replicated.
-    ``deterministic=True`` disables dropout (the numerically-testable
-    mode, = ``train=False``).  MoE layers' Switch aux losses ARE
-    collected: each stage sums its layers' sown terms over the valid
-    microbatches (``pipeline_apply``), and the per-microbatch-grouped
-    mean joins the objective at ``AUX_LOSS_COEF`` (a grouped estimator of
-    the same Switch statistic — not bitwise the full-batch value; see the
-    note in ``device_step``).
-    """
-    from tpu_hc_bench.train.step import make_optimizer
-
+def _pp_forward(model, num_microbatches: int, deterministic: bool):
+    """The shared DP x PP stage forward, derived from the model's
+    ``pp_embed``/``pp_layer_module``/``pp_head`` interface; returns
+    ``forward(params, tokens, rng) -> (logits, aux_sum)``.  Must run
+    inside a shard_map binding the pipe axis."""
     layer = model.pp_layer_module()
-    tx = make_optimizer(cfg)
 
     def block_fn(p, h, key):
         rngs = None if key is None else {"dropout": key}
@@ -269,6 +252,70 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
         ys, aux = pipeline_apply(block_fn, params["trunk"], xs, rng=rng)
         x = ys.reshape(b, s, model.hidden)
         return model.pp_head(params, x), aux
+
+    return forward
+
+
+def build_pp_eval_step(mesh: Mesh, model, cfg, num_microbatches: int,
+                       example_params: dict, tp: bool = False):
+    """Forward-only DP x PP eval step (tf_cnn --eval under
+    --pipeline_parallel, round 3): returns ``step(params, batch) ->
+    (loss, correct)`` with the exact global weighted mean, matching
+    ``train.step.build_eval_step``'s arms so PP eval reports the same
+    numbers as DP eval of the same checkpoint."""
+    del cfg
+    forward = _pp_forward(model, num_microbatches, deterministic=True)
+
+    def device_eval(params, batch):
+        from tpu_hc_bench.train.step import weighted_text_metrics
+
+        tokens, targets, weights = batch
+        logits, _ = forward(params, tokens, None)
+        num, den, correct = weighted_text_metrics(logits, targets, weights)
+        num = jax.lax.psum(num, DATA_AXIS)
+        den = jax.lax.psum(den, DATA_AXIS)
+        correct = jax.lax.psum(correct, DATA_AXIS)
+        # outputs are identical on every pipe rank (the head runs on the
+        # broadcast pipeline output) — no pipe reduction needed
+        return num / jnp.maximum(den, 1.0), correct
+
+    pspecs = pp_param_specs(example_params)
+    manual: dict = {}
+    if tp:
+        manual = {"axis_names": frozenset({DATA_AXIS, PIPE_AXIS})}
+    shard_fn = jax.shard_map(
+        device_eval, mesh=mesh,
+        in_specs=(pspecs, P(DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+        **manual,
+    )
+    return jax.jit(shard_fn)
+
+
+def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
+                        example_params: dict, example_opt_state,
+                        deterministic: bool = False, tp: bool = False):
+    """DP x PP training step for any decoder exposing the PP interface.
+
+    ``model`` implements ``pp_embed`` / ``pp_layer_module`` / ``pp_head``
+    (GPTLM and LlamaLM today) and its params have been restacked with
+    ``stack_layer_params``.  The stage forward is DERIVED from those
+    methods — no per-family wiring lives here.  The step is a
+    ``shard_map`` over the ``(data, pipe)`` mesh: batch sharded over
+    data, trunk sharded over pipe, embed/head replicated.
+    ``deterministic=True`` disables dropout (the numerically-testable
+    mode, = ``train=False``).  MoE layers' Switch aux losses ARE
+    collected: each stage sums its layers' sown terms over the valid
+    microbatches (``pipeline_apply``), and the per-microbatch-grouped
+    mean joins the objective at ``AUX_LOSS_COEF`` (a grouped estimator of
+    the same Switch statistic — not bitwise the full-batch value; see the
+    note in ``device_step``).
+    """
+    from tpu_hc_bench.train.step import make_optimizer
+
+    tx = make_optimizer(cfg)
+    forward = _pp_forward(model, num_microbatches, deterministic)
 
     def device_step(params, opt_state, batch, rng):
         tokens, targets, weights = batch
@@ -359,11 +406,14 @@ def build_pp_train_step(mesh: Mesh, model, cfg, num_microbatches: int,
 def place_pp_state(params: dict, opt_state, mesh: Mesh, tp: bool = False):
     """Place a PP ``(params, opt_state)`` on the mesh: trunk sharded over
     the pipe axis (and, with ``tp``, feature dims over the model axis),
-    everything else replicated."""
+    everything else replicated.  ``opt_state=None`` places params only
+    (forward-only eval never needs the params-sized momentum trace)."""
     pspecs = pp_param_specs(params, tp=tp)
-    ospecs = _opt_specs(opt_state, pspecs, params)
     put = lambda tree, specs: jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
+    if opt_state is None:
+        return put(params, pspecs)
+    ospecs = _opt_specs(opt_state, pspecs, params)
     return put(params, pspecs), put(opt_state, ospecs)
 
 

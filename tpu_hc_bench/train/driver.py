@@ -248,11 +248,15 @@ def _maybe_restore(state, cfg, print_fn):
 
 
 def _save_state(state, cfg, print_fn, pp_ctx=None):
-    """Save to --train_dir (process 0 only).  ``state`` is a TrainState, or
-    the PP ``(params, opt_state)`` tuple when ``pp_ctx=(model, template)``
-    — the DP<->DPxPP checkpoint interchange: PP runs restack into the DP
-    layout so the checkpoint restores under either strategy."""
-    if not cfg.train_dir or jax.process_index() != 0:
+    """Save to --train_dir.  ``state`` is a TrainState, or the PP
+    ``(params, opt_state)`` tuple when ``pp_ctx=(model, template)`` — the
+    DP<->DPxPP checkpoint interchange: PP runs restack into the DP layout
+    so the checkpoint restores under either strategy.
+
+    Multi-process: ALL processes call (Orbax synchronizes internally and
+    the primary host writes the replicated arrays); the driver guard has
+    already ensured the state is replicated, not model-sharded."""
+    if not cfg.train_dir:
         return
     from tpu_hc_bench.utils import checkpoint as ckpt
 
@@ -269,16 +273,25 @@ def _save_state(state, cfg, print_fn, pp_ctx=None):
     print_fn(f"checkpoint saved: {path}")
 
 
+_RANDOM_INIT_EVAL_WARNING = (
+    "WARNING: --eval without --train_dir measures RANDOMLY INITIALIZED "
+    "params — the accuracy line is meaningless; train with --train_dir "
+    "first and pass it here")
+
+
 def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
-              fab, print_fn, follow_inputs=False):
+              fab, print_fn, follow_inputs=False, eval_step=None):
     """tf_cnn_benchmarks --eval: timed forward passes + top-1 accuracy.
 
     ``follow_inputs=True``: TP/EP eval — the state enters model-sharded
-    and the GSPMD eval step follows its committed shardings."""
+    and the GSPMD eval step follows its committed shardings.
+    ``eval_step``: pre-built override (the PP eval step) with the same
+    ``(state, batch) -> (loss, correct)`` contract."""
     from tpu_hc_bench.train import step as step_mod
 
-    eval_step = step_mod.build_eval_step(mesh, cfg, spec,
-                                         follow_inputs=follow_inputs)
+    if eval_step is None:
+        eval_step = step_mod.build_eval_step(mesh, cfg, spec,
+                                             follow_inputs=follow_inputs)
     units = _example_units(cfg, spec)
     for _ in range(max(1, min(cfg.num_warmup_batches, 5))):
         loss, correct = eval_step(state, next(batch_iter))
@@ -338,14 +351,6 @@ def run_benchmark(
 
     fab = fabric_mod.resolve_fabric(fabric_name)
     layout = layout or discover_layout()
-    if cfg.train_dir and jax.process_count() > 1:
-        # utils.checkpoint is single-controller: host 0 device_gets the
-        # FULL state (non-addressable under a multi-host mesh -> raises),
-        # and restore on hosts without a shared filesystem would diverge.
-        raise ValueError(
-            "--train_dir checkpointing is single-process only: multi-host "
-            "save/restore needs per-shard Orbax I/O + a barrier (save the "
-            "checkpoint from a 1-process run, or drop --train_dir here)")
     # TP/EP claim the mesh's "model" axis, PP "pipe", SP "seq".  Round 2:
     # minor axes COMPOSE — DPxPPxTP and DPxSPxTP are the supported 3-D
     # hybrids (PP/SP manual shard_map axes, model auto/GSPMD); the other
@@ -370,6 +375,20 @@ def run_benchmark(
         raise ValueError(
             "--expert_parallel composes with data parallelism only")
     mp = max(tp, ep) * pp * sp      # minor product = DP-degree divisor
+    if cfg.train_dir and jax.process_count() > 1:
+        # Plain-DP state is REPLICATED: every host holds full copies, so
+        # process 0's device_get-and-save works and every process can
+        # restore (— from a SHARED filesystem; pods mount one).  Model-
+        # sharded states (TP/EP/PP/SP) are not fully addressable per host
+        # and need per-shard Orbax I/O: rejected until that exists.
+        if mp > 1:
+            raise ValueError(
+                "--train_dir under a multi-host model-sharded mesh "
+                "(TP/EP/PP/SP) is not supported: shards are not "
+                "addressable from one host; train with --train_dir on a "
+                "single process or drop the model-sharding flags")
+        print_fn("--train_dir multi-process: process 0 writes; restore "
+                 "requires a filesystem shared by all hosts")
     if layout.total_workers % mp:
         raise ValueError(
             f"--model_parallel/--expert_parallel/--pipeline_parallel/"
@@ -601,8 +620,6 @@ def run_benchmark(
         train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
         batch_iter = batches()
     elif pp > 1:
-        if cfg.eval:
-            raise ValueError("--eval with --pipeline_parallel is not supported")
         # the PP step builder derives the stage forward from the model's
         # pp_embed/pp_layer_module/pp_head interface (GPT + llama
         # families); models without it (CNNs, encoder-only) can't pipeline
@@ -641,12 +658,32 @@ def run_benchmark(
                 pp_base_step = int(np.asarray(restored_t.step))
                 params, opt_state = pipe_mod.pp_state_from_train_state(
                     restored_t, model.num_layers)
-                params, opt_state = pipe_mod.place_pp_state(
-                    params, opt_state, mesh, tp=tp > 1)
+                if cfg.eval:
+                    # forward-only: never place the params-sized momentum
+                    # trace (a PP model may not fit one device WITH it)
+                    params = pipe_mod.place_pp_state(
+                        params, None, mesh, tp=tp > 1)
+                else:
+                    params, opt_state = pipe_mod.place_pp_state(
+                        params, opt_state, mesh, tp=tp > 1)
             pp_save_ctx = (model, pp_template, pp_base_step)
         if not restored:
+            if cfg.eval and cfg.train_dir:
+                raise FileNotFoundError(
+                    f"--eval: no checkpoint found under {cfg.train_dir}")
             params, opt_state = pipe_mod.make_pp_state(model, cfg, batch[0],
                                                        mesh, tp=tp > 1)
+        if cfg.eval:
+            # round 3: PP eval — forward-only pipeline (deterministic),
+            # same loss/top-1 arms as DP eval of the same checkpoint
+            if not restored:
+                print_fn(_RANDOM_INIT_EVAL_WARNING)
+            pp_eval = pipe_mod.build_pp_eval_step(
+                mesh, model, cfg, num_mb, params, tp=tp > 1)
+            return _run_eval(
+                cfg, spec, layout, mesh, params, batches(), global_batch,
+                fab, print_fn, eval_step=pp_eval,
+            )
         pp_step, _ = pipe_mod.build_pp_train_step(
             mesh, model, cfg, num_mb, params, opt_state, tp=tp > 1)
         state = (params, opt_state)
@@ -663,10 +700,7 @@ def run_benchmark(
             if cfg.train_dir:
                 raise FileNotFoundError(
                     f"--eval: no checkpoint found under {cfg.train_dir}")
-            print_fn(
-                "WARNING: --eval without --train_dir measures RANDOMLY "
-                "INITIALIZED params — the accuracy line is meaningless; "
-                "train with --train_dir first and pass it here")
+            print_fn(_RANDOM_INIT_EVAL_WARNING)
         if mp > 1:
             mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
             state = step_mod.shard_state_tp(state, mesh, mode)

@@ -426,6 +426,20 @@ def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
     return step
 
 
+def weighted_text_metrics(logits, targets, weights):
+    """Per-shard weighted-CE numerator/denominator + weighted top-1
+    correct count — THE one home of the text-eval metric formulas (the
+    DP, TP/EP-GSPMD, and PP eval arms must all report the same numbers,
+    so they all call this)."""
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets)
+    num = (losses * weights).sum()
+    den = weights.sum()
+    correct = jnp.sum(
+        (jnp.argmax(logits, -1) == targets) * weights).astype(jnp.float32)
+    return num, den, correct
+
+
 def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
                     follow_inputs: bool = False):
     """Eval step (tf_cnn_benchmarks --eval): forward pass, loss + top-1.
@@ -450,13 +464,8 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
                                 train=False)
         if is_text:
             _, targets, weights = batch
-            losses = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets
-            )
-            num, den = (losses * weights).sum(), weights.sum()
-            correct = jnp.sum(
-                (jnp.argmax(logits, -1) == targets) * weights
-            )
+            num, den, correct = weighted_text_metrics(
+                logits, targets, weights)
             if not follow_inputs:
                 # psum numerator/denominator separately: the GLOBAL
                 # weighted mean (a mean of per-shard means would weight
