@@ -165,3 +165,31 @@ def test_eval_under_pp_matches_dp(mesh8, tmp_path):
     assert top1_pp == top1_dp
     np.testing.assert_allclose(res_pp.final_loss, res_dp.final_loss,
                                rtol=1e-4)
+
+
+def test_eval_under_sp_matches_dp(mesh8, tmp_path):
+    """Round 3: --eval under --sequence_parallel — the (data, seq)
+    shard_map eval arm reports the same top-1/loss as DP eval of the same
+    checkpoint (equal global batch of 8, same token stream)."""
+    train_dir = str(tmp_path / "sp_eval")
+    cfg = tiny_cfg(model="bert_tiny", batch_size=2, train_dir=train_dir)
+    driver.run_benchmark(cfg, print_fn=lambda _: None)
+
+    def run_eval(batch_size, **kw):
+        out = []
+        cfg = tiny_cfg(model="bert_tiny", batch_size=batch_size,
+                       eval=True, num_batches=2, train_dir=train_dir, **kw)
+        res = driver.run_benchmark(cfg, print_fn=out.append)
+        return res, [l for l in out if "top_1 accuracy" in l][0]
+
+    res_dp, top1_dp = run_eval(batch_size=1)
+    res_sp, top1_sp = run_eval(batch_size=2, sequence_parallel=2)
+    assert top1_sp == top1_dp
+    np.testing.assert_allclose(res_sp.final_loss, res_dp.final_loss,
+                               rtol=1e-4)
+    # the hybrid stays rejected
+    cfg = tiny_cfg(model="bert_tiny", batch_size=4, eval=True,
+                   sequence_parallel=2, model_parallel=2,
+                   train_dir=train_dir)
+    with pytest.raises(ValueError, match="DPxSPxTP"):
+        driver.run_benchmark(cfg, print_fn=lambda _: None)

@@ -251,8 +251,11 @@ def test_sp_flag_translation_and_guards():
     cfg = flags.BenchmarkConfig(sequence_parallel=2,
                                 attention_impl="flash").resolve()
     assert cfg.attention_impl == "ulysses_flash"
-    with pytest.raises(ValueError, match="sequence_parallel"):
-        flags.BenchmarkConfig(attention_impl="ring").resolve()
+    # round 3: a seq-sharded impl at sequence_parallel=1 is the DEGENERATE
+    # SP mode (size-1 seq axis), allowed for plain DP and recorded in the
+    # translation audit trail
+    cfg = flags.BenchmarkConfig(attention_impl="ring").resolve()
+    assert any("degenerate seq axis" in l for l in cfg.summary_lines())
     with pytest.raises(ValueError, match="not a supported composition"):
         flags.BenchmarkConfig(sequence_parallel=2,
                               pipeline_parallel=2).resolve()

@@ -279,19 +279,34 @@ _RANDOM_INIT_EVAL_WARNING = (
     "first and pass it here")
 
 
+def _require_checkpoint_for_eval(cfg, restored: bool, print_fn) -> None:
+    """The one home of the eval-restore policy (all eval arms): a named
+    --train_dir with no checkpoint is an error; no --train_dir at all
+    warns that random init is being measured."""
+    if restored:
+        return
+    if cfg.train_dir:
+        raise FileNotFoundError(
+            f"--eval: no checkpoint found under {cfg.train_dir}")
+    print_fn(_RANDOM_INIT_EVAL_WARNING)
+
+
 def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
-              fab, print_fn, follow_inputs=False, eval_step=None):
+              fab, print_fn, follow_inputs=False, eval_step=None,
+              sp=False):
     """tf_cnn_benchmarks --eval: timed forward passes + top-1 accuracy.
 
     ``follow_inputs=True``: TP/EP eval — the state enters model-sharded
     and the GSPMD eval step follows its committed shardings.
+    ``sp=True``: the (data, seq) shard_map eval arm.
     ``eval_step``: pre-built override (the PP eval step) with the same
     ``(state, batch) -> (loss, correct)`` contract."""
     from tpu_hc_bench.train import step as step_mod
 
     if eval_step is None:
         eval_step = step_mod.build_eval_step(mesh, cfg, spec,
-                                             follow_inputs=follow_inputs)
+                                             follow_inputs=follow_inputs,
+                                             sp=sp)
     units = _example_units(cfg, spec)
     for _ in range(max(1, min(cfg.num_warmup_batches, 5))):
         loss, correct = eval_step(state, next(batch_iter))
@@ -444,9 +459,9 @@ def run_benchmark(
             raise ValueError(
                 f"sequence length {seq_len} not divisible by "
                 f"sequence_parallel={sp}")
-        if cfg.eval:
-            raise ValueError("--eval with --sequence_parallel is not "
-                             "supported")
+        if cfg.eval and tp > 1:
+            raise ValueError("--eval under the DPxSPxTP hybrid is not "
+                             "supported; evaluate under SP or TP alone")
 
     # real-data split, resolved ONCE: both the --num_epochs sizing and
     # the dataset construction below must read the same shards (eval
@@ -607,7 +622,7 @@ def run_benchmark(
         init_model = model.clone(attention_impl="dense", seq_axis=None)
         state = step_mod.make_train_state(init_model, cfg, batch)
         state = state.replace(apply_fn=model.apply)
-        state, _ = _maybe_restore(state, cfg, print_fn)
+        state, sp_restored = _maybe_restore(state, cfg, print_fn)
         if tp > 1:
             # DP x SP x TP: params/opt model-sharded (auto axis), the SP
             # step's shard_map stays manual over data+seq only
@@ -615,10 +630,19 @@ def run_benchmark(
             state = step_mod.shard_state_tp(state, mesh)
         else:
             state = step_mod.replicate_state(state, mesh)
+        batch_iter = batches()
+        if cfg.eval:
+            # round 3: SP eval — the (data, seq) shard_map eval arm with
+            # the shared text-metric formulas (exact global weighted
+            # mean), completing the eval matrix (DP/TP/EP/PP/SP)
+            _require_checkpoint_for_eval(cfg, sp_restored, print_fn)
+            return _run_eval(
+                cfg, spec, layout, mesh, state, batch_iter, global_batch,
+                fab, print_fn, sp=True,
+            )
         # the shared psum step builder handles SP (axes = (data, seq),
         # fusion buckets reduce over both)
         train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
-        batch_iter = batches()
     elif pp > 1:
         # the PP step builder derives the stage forward from the model's
         # pp_embed/pp_layer_module/pp_head interface (GPT + llama
@@ -656,28 +680,29 @@ def run_benchmark(
             restored_t, restored = _maybe_restore(pp_template, cfg, print_fn)
             if restored:
                 pp_base_step = int(np.asarray(restored_t.step))
-                params, opt_state = pipe_mod.pp_state_from_train_state(
-                    restored_t, model.num_layers)
                 if cfg.eval:
-                    # forward-only: never place the params-sized momentum
-                    # trace (a PP model may not fit one device WITH it)
+                    # forward-only: never restack or place the
+                    # params-sized momentum trace (a PP model may not fit
+                    # one device WITH it)
+                    params = pipe_mod.stack_layer_params(
+                        restored_t.params, model.num_layers)
                     params = pipe_mod.place_pp_state(
                         params, None, mesh, tp=tp > 1)
+                    opt_state = None
                 else:
+                    params, opt_state = pipe_mod.pp_state_from_train_state(
+                        restored_t, model.num_layers)
                     params, opt_state = pipe_mod.place_pp_state(
                         params, opt_state, mesh, tp=tp > 1)
             pp_save_ctx = (model, pp_template, pp_base_step)
         if not restored:
-            if cfg.eval and cfg.train_dir:
-                raise FileNotFoundError(
-                    f"--eval: no checkpoint found under {cfg.train_dir}")
+            if cfg.eval:
+                _require_checkpoint_for_eval(cfg, restored, print_fn)
             params, opt_state = pipe_mod.make_pp_state(model, cfg, batch[0],
                                                        mesh, tp=tp > 1)
         if cfg.eval:
             # round 3: PP eval — forward-only pipeline (deterministic),
             # same loss/top-1 arms as DP eval of the same checkpoint
-            if not restored:
-                print_fn(_RANDOM_INIT_EVAL_WARNING)
             pp_eval = pipe_mod.build_pp_eval_step(
                 mesh, model, cfg, num_mb, params, tp=tp > 1)
             return _run_eval(
@@ -696,11 +721,8 @@ def run_benchmark(
     else:
         state = step_mod.make_train_state(model, cfg, batch)
         state, restored = _maybe_restore(state, cfg, print_fn)
-        if cfg.eval and not restored:
-            if cfg.train_dir:
-                raise FileNotFoundError(
-                    f"--eval: no checkpoint found under {cfg.train_dir}")
-            print_fn(_RANDOM_INIT_EVAL_WARNING)
+        if cfg.eval:
+            _require_checkpoint_for_eval(cfg, restored, print_fn)
         if mp > 1:
             mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
             state = step_mod.shard_state_tp(state, mesh, mode)

@@ -441,7 +441,7 @@ def weighted_text_metrics(logits, targets, weights):
 
 
 def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
-                    follow_inputs: bool = False):
+                    follow_inputs: bool = False, sp: bool = False):
     """Eval step (tf_cnn_benchmarks --eval): forward pass, loss + top-1.
 
     Uses running BN statistics (``train=False``) and no dropout.  Returns
@@ -453,8 +453,16 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
     committed (``shard_state_tp``) and jit follows them — GSPMD inserts
     the Megatron all-reduces in the forward, so a TP-trained state
     evaluates in its native sharding instead of being re-replicated.
+
+    ``sp=True`` is the sequence-parallel arm: shard_map over
+    ``(data, seq)`` with the batch's [B, S] dims split over both axes and
+    metrics psummed over both — same numbers as the DP arm by the shared
+    ``weighted_text_metrics`` formulas.
     """
     is_text = spec.is_text
+    from tpu_hc_bench.topology import SEQ_AXIS
+
+    axes = (DATA_AXIS, SEQ_AXIS) if sp else (DATA_AXIS,)
 
     def device_eval(state: TrainState, batch):
         variables = {"params": state.params}
@@ -471,8 +479,8 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
                 # weighted mean (a mean of per-shard means would weight
                 # shards equally regardless of their valid-token counts,
                 # and the DP vs TP eval arms must report the same number)
-                num = jax.lax.psum(num, DATA_AXIS)
-                den = jax.lax.psum(den, DATA_AXIS)
+                num = jax.lax.psum(num, axes)
+                den = jax.lax.psum(den, axes)
             loss = num / jnp.maximum(den, 1.0)
         else:
             _, labels = batch
@@ -480,20 +488,20 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
                 logits, labels
             ).mean()
             if not follow_inputs:
-                loss = jax.lax.pmean(loss, DATA_AXIS)
+                loss = jax.lax.pmean(loss, axes)
             correct = jnp.sum(jnp.argmax(logits, -1) == labels)
         correct = correct.astype(jnp.float32)
         if follow_inputs:
             # global-batch program: loss/correct are already global
             return loss, correct
-        return loss, jax.lax.psum(correct, DATA_AXIS)
+        return loss, jax.lax.psum(correct, axes)
 
     if follow_inputs:
         return jax.jit(device_eval)
     shard_fn = jax.shard_map(
         device_eval,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS)),
+        in_specs=(P(), P(*axes)),
         out_specs=(P(), P()),
         check_vma=False,
     )
