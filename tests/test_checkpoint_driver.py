@@ -193,3 +193,24 @@ def test_eval_under_sp_matches_dp(mesh8, tmp_path):
                    train_dir=train_dir)
     with pytest.raises(ValueError, match="DPxSPxTP"):
         driver.run_benchmark(cfg, print_fn=lambda _: None)
+
+
+def test_eval_under_ep_matches_dp(mesh8, tmp_path):
+    """--eval --expert_parallel rides the same follow-inputs GSPMD arm as
+    TP eval; parity vs DP eval of the same MoE checkpoint."""
+    train_dir = str(tmp_path / "ep_eval")
+    cfg = tiny_cfg(model="moe_tiny", batch_size=2, train_dir=train_dir)
+    driver.run_benchmark(cfg, print_fn=lambda _: None)
+
+    def run_eval(batch_size, **kw):
+        out = []
+        cfg = tiny_cfg(model="moe_tiny", batch_size=batch_size, eval=True,
+                       num_batches=2, train_dir=train_dir, **kw)
+        res = driver.run_benchmark(cfg, print_fn=out.append)
+        return res, [l for l in out if "top_1 accuracy" in l][0]
+
+    res_dp, top1_dp = run_eval(batch_size=1)
+    res_ep, top1_ep = run_eval(batch_size=2, expert_parallel=2)
+    assert top1_ep == top1_dp
+    np.testing.assert_allclose(res_ep.final_loss, res_dp.final_loss,
+                               rtol=1e-5)

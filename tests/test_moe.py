@@ -218,3 +218,16 @@ def test_moe_impl_flag_guards():
 def test_ep_exclusive_with_tp():
     with pytest.raises(ValueError, match="exclusive"):
         flags.BenchmarkConfig(model_parallel=2, expert_parallel=2).resolve()
+
+
+def test_moe_impl_auto_translation():
+    """--moe_impl=auto: ragged for single-shard experts, einsum under
+    EP/TP sharding (round 3) — recorded in the audit trail."""
+    from tpu_hc_bench import flags as fl
+
+    cfg = fl.BenchmarkConfig(model="moe_tiny", moe_impl="auto").resolve()
+    assert cfg.moe_impl == "ragged"
+    assert any("auto->ragged" in l for l in cfg.summary_lines())
+    cfg = fl.BenchmarkConfig(model="moe_tiny", moe_impl="auto",
+                             expert_parallel=2).resolve()
+    assert cfg.moe_impl == "einsum"

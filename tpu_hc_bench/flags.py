@@ -299,6 +299,16 @@ class BenchmarkConfig:
                 t["variable_update"] = (f"{prior}; {note2}" if prior
                                         else note2)
                 self.variable_update = "psum"
+        if self.moe_impl == "auto":
+            # round 3: pick the dispatch by context — ragged grouped
+            # matmuls for single-shard expert compute (zero token drops,
+            # the only impl that compiles at seq >= 4096), the GShard
+            # einsum for EP/TP where the expert tensors shard (GSPMD)
+            new = ("einsum" if (self.expert_parallel > 1
+                                or self.model_parallel > 1) else "ragged")
+            t["moe_impl"] = (f"auto->{new} (ragged for single-shard "
+                             f"experts, einsum under EP/TP sharding)")
+            self.moe_impl = new
         if self.moe_impl == "ragged" and self.moe_capacity_factor != 1.25:
             raise ValueError(
                 "--moe_capacity_factor applies to the einsum dispatch only: "
@@ -438,7 +448,7 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["dense", "flash", "ring", "ulysses",
                             "ulysses_flash"])
     p.add_argument("--moe_impl", type=str, default=d.moe_impl,
-                   choices=["einsum", "ragged"])
+                   choices=["auto", "einsum", "ragged"])
     return p
 
 
