@@ -109,14 +109,15 @@ def test_train_dir_multi_process_policy(monkeypatch, tmp_path):
     import jax
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
-    cfg = tiny_cfg(model="bert_tiny", batch_size=2,
-                   train_dir=str(tmp_path / "ckpt"), model_parallel=2)
+    # PP restacks through the DP-layout interchange -> still rejected
+    cfg = tiny_cfg(model="moe_tiny", batch_size=4, pipeline_parallel=2,
+                   train_dir=str(tmp_path / "ckpt"))
     with pytest.raises(ValueError, match="not supported"):
         driver.run_benchmark(cfg, print_fn=lambda _: None)
-    # the allowed plain-DP arm (save + both-process restore) is covered
-    # by the REAL 2-process test:
-    # test_multiprocess.py::test_two_process_checkpoint_roundtrip
-    # (a faked process_count here would break orbax's multihost gather)
+    # the allowed arms (plain-DP replicated save; TP/EP sharded Orbax
+    # I/O) are covered by the REAL 2-process tests in
+    # test_multiprocess.py (a faked process_count here would break
+    # orbax's multihost gather)
 
 
 def test_eval_under_tp_matches_dp(mesh8, tmp_path):

@@ -217,6 +217,56 @@ CKPT_WORKER = textwrap.dedent("""
 """)
 
 
+SHARDED_CKPT_WORKER = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    import numpy as np
+
+    from tpu_hc_bench.parallel import distributed
+    from tpu_hc_bench import flags, topology
+    from tpu_hc_bench.data.synthetic import SyntheticTokens
+    from tpu_hc_bench.models import create_model
+    from tpu_hc_bench.train import step as step_mod
+    from tpu_hc_bench.utils import checkpoint as ckpt
+
+    port = int(sys.argv[1]); ckpt_dir = sys.argv[2]
+    distributed.initialize(coordinator_port=port)
+    assert jax.process_count() == 2 and jax.device_count() == 4
+
+    # TP state across 2 processes: params sharded over the model axis,
+    # shards NOT addressable from one host — the sharded-save case
+    layout = topology.discover_layout(workers_per_host=0)
+    mesh = topology.build_mesh(layout, model_parallel=4)
+    cfg = flags.BenchmarkConfig(model="bert_tiny", batch_size=1,
+                                model_parallel=4).resolve()
+    model, spec = create_model("bert_tiny")
+    raw = SyntheticTokens(1, 32, vocab_size=1024).batch()
+    state = step_mod.make_train_state(model, cfg, raw)
+    state = step_mod.shard_state_tp(state, mesh)
+    qkv = state.params["layer_0"]["MultiHeadAttention_0"]["qkv"]["kernel"]
+    assert not qkv.is_fully_addressable        # the real multi-host case
+    state = state.replace(step=jax.numpy.ones((), jax.numpy.int32) * 7)
+
+    ckpt.save(state, ckpt_dir, sharded=True)   # ALL processes call
+
+    # restore into a zeroed placed template with the SAME shardings
+    zeros = jax.tree.map(lambda x: jax.device_put(
+        np.zeros(x.shape, x.dtype), x.sharding), state.params)
+    template = state.replace(params=zeros)
+    back = ckpt.restore(template, ckpt_dir, sharded=True)
+    assert int(jax.device_get(back.step)) == 7
+    got = back.params["layer_0"]["MultiHeadAttention_0"]["qkv"]["kernel"]
+    # compare this process's addressable shards
+    want = {s.index: np.asarray(s.data) for s in qkv.addressable_shards}
+    for s in got.addressable_shards:
+        np.testing.assert_allclose(np.asarray(s.data), want[s.index],
+                                   rtol=1e-6)
+    print(f"MP_SHARDED_CKPT_OK process={jax.process_index()}", flush=True)
+""")
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -290,6 +340,95 @@ def test_two_process_checkpoint_roundtrip(tmp_path):
     filesystem (round 3: the multi-process checkpoint policy)."""
     _run_two_workers(tmp_path, CKPT_WORKER, "MP_CKPT_OK",
                      extra_args=[tmp_path / "shared_ckpt"])
+
+
+TP_CKPT_WORKER = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from tpu_hc_bench.parallel import distributed
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.train import driver
+
+    port = int(sys.argv[1]); train_dir = sys.argv[2]
+    distributed.initialize(coordinator_port=port)
+
+    def run():
+        cfg = flags.BenchmarkConfig(
+            model="bert_tiny", batch_size=1, model_parallel=2,
+            num_warmup_batches=1, num_batches=2, display_every=1,
+            train_dir=train_dir).resolve()
+        out = []
+        driver.run_benchmark(cfg, print_fn=out.append)
+        return "\\n".join(out)
+
+    text = run()
+    assert "sharded Orbax I/O" in text, text
+    assert "checkpoint saved" in text
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("tp_ckpt_written")
+    text = run()
+    assert "restored checkpoint step 3" in text, text
+    print(f"MP_TP_CKPT_OK process={jax.process_index()}", flush=True)
+""")
+
+
+SP_CKPT_WORKER = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from tpu_hc_bench.parallel import distributed
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.train import driver
+
+    port = int(sys.argv[1]); train_dir = sys.argv[2]
+    distributed.initialize(coordinator_port=port)
+
+    def run():
+        cfg = flags.BenchmarkConfig(
+            model="bert_tiny", batch_size=1, sequence_parallel=2,
+            num_warmup_batches=1, num_batches=2, display_every=1,
+            train_dir=train_dir).resolve()
+        out = []
+        driver.run_benchmark(cfg, print_fn=out.append)
+        return "\\n".join(out)
+
+    text = run()
+    assert "process 0 writes" in text, text    # SP state is REPLICATED
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("sp_ckpt_written")
+    text = run()
+    assert "restored checkpoint step 3" in text, text
+    print(f"MP_SP_CKPT_OK process={jax.process_index()}", flush=True)
+""")
+
+
+def test_two_process_sp_train_dir_roundtrip(tmp_path):
+    """--train_dir --sequence_parallel across 2 real processes: SP keeps
+    params fully REPLICATED, so the plain process-0-writes path must work
+    — this test pins that invariant (a future SP-step change that shards
+    params would fail here, not corrupt checkpoints silently)."""
+    _run_two_workers(tmp_path, SP_CKPT_WORKER, "MP_SP_CKPT_OK",
+                     extra_args=[tmp_path / "sp_ckpt"])
+
+
+def test_two_process_tp_train_dir_roundtrip(tmp_path):
+    """--train_dir --model_parallel across 2 real processes: the driver
+    takes the sharded-Orbax path end to end (save during training,
+    sharded restore-after-placement on resume)."""
+    _run_two_workers(tmp_path, TP_CKPT_WORKER, "MP_TP_CKPT_OK",
+                     extra_args=[tmp_path / "tp_ckpt"])
+
+
+def test_two_process_sharded_checkpoint(tmp_path):
+    """Sharded (multi-host TP) checkpointing: live jax.Arrays handed to
+    Orbax, each process writing/reading only its addressable shards."""
+    _run_two_workers(tmp_path, SHARDED_CKPT_WORKER, "MP_SHARDED_CKPT_OK",
+                     extra_args=[tmp_path / "sharded_ckpt"])
 
 
 def test_two_process_multislice_step(tmp_path):

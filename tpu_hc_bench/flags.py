@@ -303,9 +303,12 @@ class BenchmarkConfig:
             # round 3: pick the dispatch by context — ragged grouped
             # matmuls for single-shard expert compute (zero token drops,
             # the only impl that compiles at seq >= 4096), the GShard
-            # einsum for EP/TP where the expert tensors shard (GSPMD)
+            # einsum for EP/TP where the expert tensors shard (GSPMD) or
+            # when an explicit capacity factor asks for capacity routing
             new = ("einsum" if (self.expert_parallel > 1
-                                or self.model_parallel > 1) else "ragged")
+                                or self.model_parallel > 1
+                                or self.moe_capacity_factor != 1.25)
+                   else "ragged")
             t["moe_impl"] = (f"auto->{new} (ragged for single-shard "
                              f"experts, einsum under EP/TP sharding)")
             self.moe_impl = new

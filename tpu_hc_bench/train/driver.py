@@ -229,11 +229,14 @@ class _AsyncTimeline:
         return total_time, window_times
 
 
-def _maybe_restore(state, cfg, print_fn):
+def _maybe_restore(state, cfg, print_fn, sharded=False):
     """--train_dir resume: restore the latest checkpoint if one exists.
 
-    Returns ``(state, restored?)``; the caller re-places the state on the
-    mesh (restore yields host arrays).
+    Returns ``(state, restored?)``.  Default mode restores host arrays
+    (the caller re-places them on the mesh); ``sharded=True`` takes an
+    already-PLACED template and restores each array with its committed
+    sharding, every process reading only its addressable shards (the
+    multi-host model-sharded path).
     """
     if not cfg.train_dir:
         return state, False
@@ -241,13 +244,13 @@ def _maybe_restore(state, cfg, print_fn):
 
     if ckpt.latest_step(cfg.train_dir) is None:
         return state, False
-    state = ckpt.restore(state, cfg.train_dir)
+    state = ckpt.restore(state, cfg.train_dir, sharded=sharded)
     print_fn(f"restored checkpoint step "
              f"{int(jax.device_get(state.step))} from {cfg.train_dir}")
     return state, True
 
 
-def _save_state(state, cfg, print_fn, pp_ctx=None):
+def _save_state(state, cfg, print_fn, pp_ctx=None, sharded=False):
     """Save to --train_dir.  ``state`` is a TrainState, or the PP
     ``(params, opt_state)`` tuple when ``pp_ctx=(model, template)`` — the
     DP<->DPxPP checkpoint interchange: PP runs restack into the DP layout
@@ -269,7 +272,7 @@ def _save_state(state, cfg, print_fn, pp_ctx=None):
             params, opt_state, template, model.num_layers)
         state = state.replace(
             step=jax.numpy.asarray(steps_done, jax.numpy.int32))
-    path = ckpt.save(state, cfg.train_dir)
+    path = ckpt.save(state, cfg.train_dir, sharded=sharded)
     print_fn(f"checkpoint saved: {path}")
 
 
@@ -390,20 +393,27 @@ def run_benchmark(
         raise ValueError(
             "--expert_parallel composes with data parallelism only")
     mp = max(tp, ep) * pp * sp      # minor product = DP-degree divisor
+    sharded_ckpt = False
     if cfg.train_dir and jax.process_count() > 1:
-        # Plain-DP state is REPLICATED: every host holds full copies, so
-        # process 0's device_get-and-save works and every process can
-        # restore (— from a SHARED filesystem; pods mount one).  Model-
-        # sharded states (TP/EP/PP/SP) are not fully addressable per host
-        # and need per-shard Orbax I/O: rejected until that exists.
-        if mp > 1:
+        # Plain-DP/SP state is REPLICATED (every host holds full copies:
+        # process 0's device_get-and-save works, every process restores
+        # from the shared filesystem).  TP/EP states are model-SHARDED:
+        # they save/restore through Orbax's per-shard jax.Array I/O with
+        # every process participating (utils.checkpoint sharded=True).
+        # PP (and the SP hybrids) still restack through the DP-layout
+        # interchange, which needs full addressability: rejected.
+        if pp > 1 or (sp_active and max(tp, ep) > 1):
             raise ValueError(
-                "--train_dir under a multi-host model-sharded mesh "
-                "(TP/EP/PP/SP) is not supported: shards are not "
-                "addressable from one host; train with --train_dir on a "
-                "single process or drop the model-sharding flags")
-        print_fn("--train_dir multi-process: process 0 writes; restore "
-                 "requires a filesystem shared by all hosts")
+                "--train_dir under a multi-host PP or SPxTP mesh is not "
+                "supported (the DP-layout checkpoint interchange needs "
+                "fully addressable arrays); train with --train_dir on a "
+                "single process or drop those flags")
+        sharded_ckpt = max(tp, ep) > 1
+        print_fn(
+            "--train_dir multi-process: "
+            + ("sharded Orbax I/O, every process writes its shards"
+               if sharded_ckpt else "process 0 writes")
+            + "; restore requires a filesystem shared by all hosts")
     if layout.total_workers % mp:
         raise ValueError(
             f"--model_parallel/--expert_parallel/--pipeline_parallel/"
@@ -720,14 +730,20 @@ def run_benchmark(
         batch_iter = batches()
     else:
         state = step_mod.make_train_state(model, cfg, batch)
-        state, restored = _maybe_restore(state, cfg, print_fn)
-        if cfg.eval:
-            _require_checkpoint_for_eval(cfg, restored, print_fn)
+        if not sharded_ckpt:
+            state, restored = _maybe_restore(state, cfg, print_fn)
         if mp > 1:
             mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
             state = step_mod.shard_state_tp(state, mesh, mode)
         else:
             state = step_mod.replicate_state(state, mesh)
+        if sharded_ckpt:
+            # multi-host TP/EP: restore AFTER placement so Orbax reads
+            # each array straight into its committed sharding
+            state, restored = _maybe_restore(state, cfg, print_fn,
+                                             sharded=True)
+        if cfg.eval:
+            _require_checkpoint_for_eval(cfg, restored, print_fn)
         batch_iter = batches()
         if cfg.eval:
             return _run_eval(
@@ -776,7 +792,8 @@ def run_benchmark(
             # resume-aware stamp: continue the restored checkpoint's step
             # count so a resumed PP run never saves under a lower step
             ctx = (pp_model, pp_template, pp_base + warmup_steps + i)
-        _save_state(state, cfg, print_fn, pp_ctx=ctx)
+        _save_state(state, cfg, print_fn, pp_ctx=ctx,
+                    sharded=sharded_ckpt)
 
     for i in range(1, cfg.num_batches + 1):
         state, metrics = train_step(state, next(batch_iter),

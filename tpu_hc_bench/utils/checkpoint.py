@@ -25,17 +25,26 @@ def _step_dir(base: Path, step: int) -> Path:
     return base / f"step_{step:08d}"
 
 
-def save(state: TrainState, directory: str | Path) -> Path:
-    """Save the array state of `state` at its current step."""
+def save(state: TrainState, directory: str | Path,
+         sharded: bool = False) -> Path:
+    """Save the array state of `state` at its current step.
+
+    ``sharded=True`` (multi-host model-sharded states): the LIVE
+    ``jax.Array``s are handed to Orbax, which writes each process's
+    addressable shards and synchronizes internally — every process must
+    call.  Default (host) mode device_gets first, which requires the
+    state to be fully addressable (replicated or single-process).
+    """
     base = Path(directory)
     base.mkdir(parents=True, exist_ok=True)
     step = int(jax.device_get(state.step))
     path = _step_dir(base, step)
+    pull = (lambda t: t) if sharded else jax.device_get
     payload = {
         "step": np.asarray(step),
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats),
-        "opt_state": jax.device_get(state.opt_state),
+        "params": pull(state.params),
+        "batch_stats": pull(state.batch_stats),
+        "opt_state": pull(state.opt_state),
     }
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path.resolve(), payload, force=True)
@@ -55,25 +64,43 @@ def latest_step(directory: str | Path) -> int | None:
 
 
 def restore(state: TrainState, directory: str | Path,
-            step: int | None = None) -> TrainState:
+            step: int | None = None, sharded: bool = False) -> TrainState:
     """Restore into an already-constructed (template) TrainState.
 
     ``state`` supplies the tree structure, dtypes, and the non-serializable
     ``apply_fn``/``tx``; arrays are replaced from the checkpoint.
+
+    ``sharded=True``: ``state`` must already be PLACED on the mesh (its
+    arrays carry shardings); Orbax restores each array with that
+    sharding, every process reading only the shards it addresses —
+    the multi-host restore for model-sharded states.
     """
     base = Path(directory)
     if step is None:
         step = latest_step(base)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {base}")
+    pull = (lambda t: t) if sharded else jax.device_get
     template = {
         "step": jax.device_get(state.step),
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats),
-        "opt_state": jax.device_get(state.opt_state),
+        "params": pull(state.params),
+        "batch_stats": pull(state.batch_stats),
+        "opt_state": pull(state.opt_state),
     }
+    restore_args = None
+    if sharded:
+        def as_restore_args(x):
+            return ocp.ArrayRestoreArgs(sharding=x.sharding,
+                                        global_shape=x.shape,
+                                        dtype=x.dtype)
+        restore_args = {
+            k: (ocp.RestoreArgs() if k == "step"
+                else jax.tree.map(as_restore_args, template[k]))
+            for k in template
+        }
     ckptr = ocp.PyTreeCheckpointer()
-    payload = ckptr.restore(_step_dir(base, step).resolve(), item=template)
+    payload = ckptr.restore(_step_dir(base, step).resolve(), item=template,
+                            restore_args=restore_args)
     return state.replace(
         step=jax.numpy.asarray(payload["step"], dtype=jax.numpy.int32),
         params=payload["params"],
