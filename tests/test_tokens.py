@@ -88,3 +88,19 @@ def test_text_driver_real_corpus(mesh8, tmp_path):
         out = []
         res = driver.run_benchmark(cfg, print_fn=out.append)
         assert np.isfinite(res.final_loss), model
+
+
+def test_tokens_cli(tmp_path, capsys):
+    from tpu_hc_bench.data import tokens as tok_mod
+
+    tok_mod.main([str(tmp_path / "rand"), "--num_tokens", "1000",
+                  "--vocab_size", "512"])
+    ds = tokens.TokenDataset(tmp_path / "rand", 2, 8)
+    assert ds.batch()[0].max() < 512
+
+    (tmp_path / "c.txt").write_text("hello corpus " * 100)
+    tok_mod.main([str(tmp_path / "text"), "--from_text",
+                  str(tmp_path / "c.txt")])
+    ds = tokens.TokenDataset(tmp_path / "text", 2, 8, vocab_size=256)
+    t, y, w = ds.batch()
+    assert t.max() < 256
