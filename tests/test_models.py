@@ -6,6 +6,8 @@ a strong structural check that the fresh implementations match the
 architectures tf_cnn_benchmarks drives.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -404,3 +406,90 @@ def test_vit_l16_params():
     # ViT-L/16 ~304M
     assert 295e6 < count < 315e6, count
     assert model.apply(variables, x, train=False).shape == (1, 1000)
+
+
+def test_ncf_shapes_and_params():
+    """NeuMF (tf_cnn's ncf member): head shape + ml-20m parameter count
+    (embeddings dominate: (138493+26744)*(64+128) + MLP tower)."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_hc_bench.models import create_model
+
+    model, spec = create_model("ncf_tiny")
+    assert spec.integer_input and spec.input_shape == (2,)
+    ids = jnp.array([[0, 0], [999, 499]], jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids, train=False)
+    logits = model.apply(variables, ids, train=False)
+    assert logits.shape == (2, 2)
+
+    model, _ = create_model("ncf")
+    variables = jax.eval_shape(
+        functools.partial(model.init, train=False),
+        jax.random.PRNGKey(0), jax.ShapeDtypeStruct((1, 2), jnp.int32))
+    n = sum(x.size for x in jax.tree.leaves(variables["params"]))
+    # (138493+26744)*64 GMF + (138493+26744)*128 MLP embeds + tower+head
+    assert 31_000_000 < n < 33_000_000, n
+
+
+def test_ncf_through_driver(mesh8):
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.train import driver
+    import numpy as np
+
+    cfg = flags.BenchmarkConfig(
+        model="ncf_tiny", batch_size=4, num_warmup_batches=1, num_batches=3,
+        display_every=1).resolve()
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    assert np.isfinite(res.final_loss)
+    # eval (binary accuracy via the standard top-1 protocol)
+    cfg = flags.BenchmarkConfig(
+        model="ncf_tiny", batch_size=4, eval=True, num_batches=2,
+        num_warmup_batches=1, display_every=1).resolve()
+    out = []
+    driver.run_benchmark(cfg, print_fn=out.append)
+    assert any("top_1 accuracy" in l for l in out)
+
+
+def test_deepspeech2_shapes_and_params():
+    """DS2 (tf_cnn's speech member): conv frontend shapes, BiGRU stack,
+    CTC head, and the ~48M-param count at the paper shape."""
+    from tpu_hc_bench.models import create_model
+
+    model, spec = create_model("deepspeech2_tiny")
+    assert spec.ctc and spec.input_shape == (64, 32)
+    x = jnp.zeros((2, 64, 32), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 16, 29)         # T/4 frames, 29-char vocab
+
+    model, _ = create_model("deepspeech2")
+    variables = jax.eval_shape(
+        functools.partial(model.init, train=False),
+        jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 300, 161), jnp.float32))
+    n = sum(x.size for x in jax.tree.leaves(variables["params"]))
+    assert 40_000_000 < n < 55_000_000, n
+
+
+def test_deepspeech2_through_driver(mesh8):
+    """CTC member end to end: SyntheticSpeech batches, optax.ctc_loss
+    in the train step, loss decreases-or-finite over a few steps."""
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.train import driver
+    import numpy as np
+
+    cfg = flags.BenchmarkConfig(
+        model="deepspeech2_tiny", batch_size=2, num_warmup_batches=1,
+        num_batches=3, display_every=1).resolve()
+    out = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    assert np.isfinite(res.final_loss)
+    assert any("examples/sec" in l for l in out)
+    # eval is out of protocol for CTC
+    cfg = flags.BenchmarkConfig(
+        model="deepspeech2_tiny", batch_size=2, eval=True,
+        num_batches=2).resolve()
+    import pytest
+    with pytest.raises(ValueError, match="CTC"):
+        driver.run_benchmark(cfg, print_fn=lambda _: None)

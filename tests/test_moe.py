@@ -221,13 +221,16 @@ def test_ep_exclusive_with_tp():
 
 
 def test_moe_impl_auto_translation():
-    """--moe_impl=auto: ragged for single-shard experts, einsum under
-    EP/TP sharding (round 3) — recorded in the audit trail."""
+    """--moe_impl=auto picks by the measured crossover (round 3,
+    BASELINE.md): einsum short-seq/EP/TP, ragged at long seq."""
     from tpu_hc_bench import flags as fl
 
     cfg = fl.BenchmarkConfig(model="moe_tiny", moe_impl="auto").resolve()
-    assert cfg.moe_impl == "ragged"
-    assert any("auto->ragged" in l for l in cfg.summary_lines())
-    cfg = fl.BenchmarkConfig(model="moe_tiny", moe_impl="auto",
-                             expert_parallel=2).resolve()
-    assert cfg.moe_impl == "einsum"
+    assert cfg.moe_impl == "einsum"              # short seq
+    assert any("auto->einsum" in l for l in cfg.summary_lines())
+    cfg = fl.BenchmarkConfig(model="gpt2_moe", moe_impl="auto",
+                             seq_len=4096).resolve()
+    assert cfg.moe_impl == "ragged"              # long seq, single-shard
+    cfg = fl.BenchmarkConfig(model="gpt2_moe", moe_impl="auto",
+                             seq_len=4096, expert_parallel=2).resolve()
+    assert cfg.moe_impl == "einsum"              # EP needs GSPMD einsum

@@ -43,6 +43,65 @@ class SyntheticImages:
 
 
 @dataclasses.dataclass
+class SyntheticSpeech:
+    """Fixed random spectrogram batch for the CTC member (deepspeech2):
+    ``(features [B, T, F], labels [B, L] int32, label_paddings [B, L]
+    float32)`` — labels in [1, vocab) (0 = CTC blank), per-example
+    transcript lengths drawn in [L/2, L] and padded with 1.0 weights."""
+
+    global_batch: int
+    frames: int
+    freq: int
+    max_label: int
+    vocab_size: int = 29
+    seed: int = 0
+
+    def batch(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        feats = rng.standard_normal(
+            (self.global_batch, self.frames, self.freq), dtype=np.float32)
+        labels = rng.integers(
+            1, self.vocab_size,
+            size=(self.global_batch, self.max_label)).astype(np.int32)
+        lengths = rng.integers(self.max_label // 2, self.max_label + 1,
+                               size=(self.global_batch,))
+        paddings = (np.arange(self.max_label)[None, :]
+                    >= lengths[:, None]).astype(np.float32)
+        return feats, labels, paddings
+
+    def __iter__(self):
+        batch = self.batch()
+        while True:
+            yield batch
+
+
+@dataclasses.dataclass
+class SyntheticIds:
+    """Fixed random id-pair batch for the NCF member: ``[B, 2] int32``
+    (user, item) ids + binary implicit-feedback labels — the same
+    fixed-batch contract as SyntheticImages."""
+
+    global_batch: int
+    num_users: int
+    num_items: int
+    seed: int = 0
+
+    def batch(self) -> tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        ids = np.stack([
+            rng.integers(0, self.num_users, self.global_batch),
+            rng.integers(0, self.num_items, self.global_batch),
+        ], axis=1).astype(np.int32)
+        labels = rng.integers(0, 2, self.global_batch).astype(np.int32)
+        return ids, labels
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        batch = self.batch()
+        while True:
+            yield batch
+
+
+@dataclasses.dataclass
 class SyntheticTokens:
     """Fixed random token batch for MLM: ids, targets, mask weights.
 

@@ -300,17 +300,29 @@ class BenchmarkConfig:
                                         else note2)
                 self.variable_update = "psum"
         if self.moe_impl == "auto":
-            # round 3: pick the dispatch by context — ragged grouped
-            # matmuls for single-shard expert compute (zero token drops,
-            # the only impl that compiles at seq >= 4096), the GShard
-            # einsum for EP/TP where the expert tensors shard (GSPMD) or
-            # when an explicit capacity factor asks for capacity routing
-            new = ("einsum" if (self.expert_parallel > 1
-                                or self.model_parallel > 1
-                                or self.moe_capacity_factor != 1.25)
-                   else "ragged")
-            t["moe_impl"] = (f"auto->{new} (ragged for single-shard "
-                             f"experts, einsum under EP/TP sharding)")
+            from tpu_hc_bench.models import get_model_spec
+
+            try:
+                is_moe = get_model_spec(self.model).moe
+            except ValueError:
+                is_moe = False      # unknown model: let create_model raise
+            if not is_moe:
+                raise ValueError(
+                    f"--moe_impl=auto only applies to MoE members, not "
+                    f"{self.model}")
+            # round 3: pick the dispatch by MEASUREMENT — einsum wins at
+            # short/medium seq (49.2 vs 31.2 ex/s on gpt2_moe seq 1024,
+            # BASELINE.md) and is the GSPMD path EP/TP require; ragged
+            # grouped matmuls take over at long seq (the O(S) dispatch:
+            # einsum needs the token-dropping capacity valve at seq 4096
+            # and fails to compile beyond)
+            long_seq = (self.seq_len or 0) >= 4096
+            new = ("ragged" if (long_seq and self.expert_parallel == 1
+                                and self.model_parallel == 1
+                                and self.moe_capacity_factor == 1.25)
+                   else "einsum")
+            t["moe_impl"] = (f"auto->{new} (einsum short-seq/EP/TP, "
+                             f"ragged at seq>=4096 single-shard)")
             self.moe_impl = new
         if self.moe_impl == "ragged" and self.moe_capacity_factor != 1.25:
             raise ValueError(

@@ -51,12 +51,18 @@ class ModelSpec:
     fused_conv: bool = False           # factory accepts fused_conv (the
                                        # Pallas bottleneck segment, v1
                                        # bottleneck resnets only)
+    integer_input: bool = False        # [B, ...] int32 id inputs
+                                       # (NCF: SyntheticIds feeds it)
+    ctc: bool = False                  # CTC objective over spectrogram
+                                       # frames (deepspeech2:
+                                       # SyntheticSpeech feeds it)
 
 
 def _registry() -> dict[str, ModelSpec]:
     from tpu_hc_bench.models import (
-        alexnet, bert, cifar_resnet, densenet, googlenet, gpt, inception,
-        llama, mobilenet, nasnet, resnet, small_cnns, vgg, vit,
+        alexnet, bert, cifar_resnet, deepspeech, densenet, googlenet, gpt,
+        inception, llama, mobilenet, nasnet, ncf, resnet, small_cnns, vgg,
+        vit,
     )
 
     specs = [
@@ -124,6 +130,18 @@ def _registry() -> dict[str, ModelSpec]:
                   default_image_size=299),
         ModelSpec("inception4", inception.inception_v4, (299, 299, 3), 24.5e9,
                   default_image_size=299),
+        # DeepSpeech2 (tf_cnn's speech member): 2 strided convs + 5x800
+        # summed BiGRU + CTC; fwd FLOPs ~= 2*MACs at [300, 161] frames
+        ModelSpec("deepspeech2", deepspeech.deepspeech2, (300, 161),
+                  1.0e10, ctc=True),
+        ModelSpec("deepspeech2_tiny", deepspeech.deepspeech2_tiny,
+                  (64, 32), 2.0e7, ctc=True),
+        # NCF/NeuMF (tf_cnn's recommendation member, MLPerf ml-20m
+        # shape): fwd FLOPs ~= 2*MACs of the MLP tower + fused head
+        # (embedding gathers are bandwidth, not MACs)
+        ModelSpec("ncf", ncf.ncf, (2,), 2.8e5, integer_input=True),
+        ModelSpec("ncf_tiny", ncf.ncf_tiny, (2,), 5.0e3,
+                  integer_input=True),
         ModelSpec("bert_base", bert.bert_base_mlm, (128,), 2 * 110e6 * 128,
                   is_text=True),
         ModelSpec("bert_large", bert.bert_large_mlm, (128,), 2 * 335e6 * 128,

@@ -1,0 +1,98 @@
+"""DeepSpeech2 — tf_cnn_benchmarks' `deepspeech2` speech member.
+
+Closes the final gap in the tf_cnn zoo inventory (SURVEY.md §2b #22).
+The architecture follows the DS2 paper / tf_cnn shape: a 2-layer strided
+conv frontend over the [time, freq] spectrogram, five bidirectional GRU
+layers (sum-merged directions, the DS2 row convention), and a CTC head
+over the 29-character English alphabet (blank id 0).
+
+TPU-first choices:
+
+- **Conv frontend as NHWC**: the spectrogram runs as a [B, T, F, C]
+  image so the big 41x11/21x11 kernels land on the MXU like any CNN.
+- **GRUs as `lax.scan`** (``flax.linen.RNN``/``Bidirectional``): the
+  recurrence compiles to a single fused scan per direction — XLA's
+  preferred RNN form — with all gate matmuls batched per step.  RNNs are
+  inherently latency-bound on wide accelerators; this member exists for
+  coverage, and its MFU ceiling is the recurrence, not the harness.
+- **CTC via ``optax.ctc_loss``** (the driver's ``ctc`` loss arm): the
+  forward-backward recursion is an XLA scan over logit frames, batched.
+
+Batch contract (data/synthetic.SyntheticSpeech): ``(features [B, T, F],
+labels [B, L] int32, label_paddings [B, L] float32)``; the model's fixed
+frame count after the conv strides bounds the label length (CTC needs
+T' >= len(label)).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# 26 letters + space + apostrophe + CTC blank (id 0)
+DS2_VOCAB = 29
+DS2_FREQ = 161                 # spectrogram bins (paper/tf_cnn input)
+DS2_FRAMES = 300               # synthetic utterance length (frames)
+DS2_MAX_LABEL = 50             # synthetic transcript length bound
+DS2_TIME_STRIDE = 4            # conv frontend's time downsampling
+                               # (conv1 stride 2 x conv2 stride 2)
+
+
+def max_label_for(frames: int) -> int:
+    """Largest CTC-feasible transcript length for an utterance of
+    ``frames``: bounded by the post-conv frame count with a margin for
+    repeated characters (each repeat needs an extra blank frame)."""
+    return min(DS2_MAX_LABEL, frames // DS2_TIME_STRIDE - 4)
+
+
+class DeepSpeech2(nn.Module):
+    vocab_size: int = DS2_VOCAB
+    rnn_hidden: int = 800
+    num_rnn_layers: int = 5
+    conv_channels: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # [B, T, F] -> [B, T, F, 1]; strided conv frontend (DS2 shapes)
+        x = x.astype(self.dtype)[..., None]
+        for kernel, strides, name in (
+                ((41, 11), (2, 2), "conv1"), ((21, 11), (2, 1), "conv2")):
+            x = nn.Conv(self.conv_channels, kernel, strides=strides,
+                        padding="SAME", use_bias=False, dtype=self.dtype,
+                        name=name)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype,
+                             name=f"{name}_bn")(x)
+            x = jnp.minimum(nn.relu(x), 20.0)      # DS2 clipped relu
+        b, t, f, c = x.shape
+        x = x.reshape(b, t, f * c)
+
+        for i in range(self.num_rnn_layers):
+            cell = lambda n: nn.RNN(nn.GRUCell(self.rnn_hidden,
+                                               dtype=self.dtype), name=n)
+            y = nn.Bidirectional(
+                cell(f"gru{i}_fwd"), cell(f"gru{i}_bwd"),
+                merge_fn=lambda a, b: a + b,        # DS2 sum-merge
+                name=f"bigru{i}")(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype,
+                             name=f"rnn{i}_bn")(y)
+        # f32 CTC head like the zoo's other heads
+        return nn.Dense(self.vocab_size, dtype=jnp.float32,
+                        name="ctc_head")(x)
+
+
+def deepspeech2(num_classes: int = DS2_VOCAB, dtype=jnp.float32):
+    """DS2 at the paper/tf_cnn shape (5x800 summed BiGRU, ~48M params)."""
+    del num_classes
+    return DeepSpeech2(dtype=dtype)
+
+
+def deepspeech2_tiny(num_classes: int = DS2_VOCAB, dtype=jnp.float32):
+    """2x32 BiGRU variant for tests/CPU smoke runs."""
+    del num_classes
+    return DeepSpeech2(rnn_hidden=32, num_rnn_layers=2, conv_channels=4,
+                       dtype=dtype)

@@ -1,0 +1,77 @@
+"""Neural Collaborative Filtering (NeuMF) — tf_cnn_benchmarks' `ncf`.
+
+Closes the last gap in the tf_cnn model-zoo inventory (SURVEY.md §2b #22;
+`ncf` and `deepspeech2` were the two members previously excluded).  The
+tf_cnn version is the MLPerf NCF recommendation benchmark: MovieLens
+user/item ids through a GMF (elementwise-product) tower and an MLP tower,
+fused into one prediction head (He et al. 2017 NeuMF).
+
+TPU-first framing: the prediction head is a 2-way softmax instead of a
+sigmoid — mathematically equivalent for binary implicit feedback, and it
+drops straight into the benchmark driver's image-family contract
+(``logits [B, num_classes]`` vs ``labels [B]``), so the standard loss,
+eval top-1 (= binary accuracy), and every parallelism arm work unchanged.
+Inputs are ``[B, 2] int32`` (user, item) id pairs — the registry marks
+the member ``integer_input`` and the driver feeds ``SyntheticIds``.
+Embedding gathers and the MLP land on the MXU as dense ops; there is no
+sequence dim, so like the CNNs it is a pure DP workload.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# MovieLens ml-20m cardinalities (the MLPerf NCF dataset tf_cnn targets)
+ML20M_USERS = 138_493
+ML20M_ITEMS = 26_744
+
+
+class NeuMF(nn.Module):
+    num_users: int = ML20M_USERS
+    num_items: int = ML20M_ITEMS
+    mf_dim: int = 64                       # GMF embedding width
+    mlp_dims: Sequence[int] = (256, 256, 128, 64)   # MLP tower (mlperf NCF)
+    num_classes: int = 2                   # binary implicit feedback
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, train: bool = True):
+        del train                           # no dropout in the benchmark
+        users, items = ids[:, 0], ids[:, 1]
+        mf_u = nn.Embed(self.num_users, self.mf_dim, dtype=self.dtype,
+                        name="mf_user")(users)
+        mf_i = nn.Embed(self.num_items, self.mf_dim, dtype=self.dtype,
+                        name="mf_item")(items)
+        gmf = mf_u * mf_i
+
+        mlp_dim = self.mlp_dims[0] // 2
+        ml_u = nn.Embed(self.num_users, mlp_dim, dtype=self.dtype,
+                        name="mlp_user")(users)
+        ml_i = nn.Embed(self.num_items, mlp_dim, dtype=self.dtype,
+                        name="mlp_item")(items)
+        x = jnp.concatenate([ml_u, ml_i], axis=-1)
+        for i, width in enumerate(self.mlp_dims[1:]):
+            x = nn.relu(nn.Dense(width, dtype=self.dtype,
+                                 name=f"mlp_{i}")(x))
+        fused = jnp.concatenate([gmf, x], axis=-1)
+        # f32 head like the rest of the zoo (loss numerics)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(fused)
+
+
+def ncf(num_classes: int = 2, dtype=jnp.float32):
+    """NeuMF at the MLPerf/ml-20m shape (~31.8M params — GMF + MLP
+    embeddings dominate: (138493+26744)x(64+128)).  ``num_classes`` is
+    forced to 2 (binary feedback)."""
+    del num_classes
+    return NeuMF(dtype=dtype)
+
+
+def ncf_tiny(num_classes: int = 2, dtype=jnp.float32):
+    """Small-vocab variant for tests/CPU smoke runs (~100k params)."""
+    del num_classes
+    return NeuMF(num_users=1000, num_items=500, mf_dim=8,
+                 mlp_dims=(32, 32, 16, 8), dtype=dtype)

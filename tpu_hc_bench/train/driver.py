@@ -61,7 +61,10 @@ def log_name(
 
 
 def _example_units(cfg: BenchmarkConfig, spec) -> str:
-    return "examples" if spec.is_text else "images"
+    if (spec.is_text or getattr(spec, "ctc", False)
+            or getattr(spec, "integer_input", False)):
+        return "examples"
+    return "images"
 
 
 def _prefetch(gen, lookahead: int = 2):
@@ -606,6 +609,47 @@ def run_benchmark(
 
         def batches():
             dev_batch = step_mod.shard_batch(batch, mesh, batch_spec)
+            while True:
+                yield dev_batch
+    elif getattr(spec, "ctc", False):
+        # deepspeech2: spectrogram frames + padded CTC transcripts
+        from tpu_hc_bench.data.synthetic import SyntheticSpeech
+        from tpu_hc_bench.models.deepspeech import max_label_for
+
+        if cfg.data_dir is not None:
+            raise ValueError(
+                f"--data_dir is not supported for {cfg.model} "
+                "(synthetic spectrograms only)")
+        if cfg.eval:
+            raise ValueError(
+                "--eval is not supported for the CTC member (decode/CER "
+                "is outside the benchmark protocol)")
+        frames, freq = spec.input_shape
+        # CTC validity: label length bounded by the post-conv frame count
+        ds = SyntheticSpeech(global_batch, frames, freq,
+                             max_label_for(frames), seed=cfg.seed)
+        batch = ds.batch()
+
+        def batches():
+            dev_batch = step_mod.shard_batch(batch, mesh)
+            while True:
+                yield dev_batch
+    elif getattr(spec, "integer_input", False):
+        # NCF: [B, 2] (user, item) id pairs + binary labels — same
+        # fixed-batch contract as the image members
+        from tpu_hc_bench.data.synthetic import SyntheticIds
+
+        if cfg.data_dir is not None:
+            raise ValueError(
+                f"--data_dir is not supported for {cfg.model} "
+                "(synthetic implicit-feedback pairs only)")
+        m = model
+        ds = SyntheticIds(global_batch, num_users=m.num_users,
+                          num_items=m.num_items, seed=cfg.seed)
+        batch = ds.batch()
+
+        def batches():
+            dev_batch = step_mod.shard_batch(batch, mesh)
             while True:
                 yield dev_batch
     else:

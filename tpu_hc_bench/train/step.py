@@ -141,7 +141,8 @@ def prep_inputs(inputs):
 
 
 def _loss_and_updates(state: TrainState, params, batch, dropout_rng,
-                      is_text: bool, fused_xent: bool = False):
+                      is_text: bool, fused_xent: bool = False,
+                      ctc: bool = False):
     """Forward + loss; returns (loss, new_batch_stats)."""
     variables = {"params": params}
     has_stats = bool(state.batch_stats)
@@ -157,7 +158,16 @@ def _loss_and_updates(state: TrainState, params, batch, dropout_rng,
     )
     new_stats = updated.get("batch_stats", {})
     aux_terms = jax.tree.leaves(updated.get("losses", {}))
-    if is_text:
+    if ctc:
+        # deepspeech2: CTC over logit frames (optax's forward-backward
+        # scan); all frames are valid (fixed synthetic length), labels
+        # carry per-example padding
+        _, labels, label_paddings = batch
+        logit_paddings = jnp.zeros(logits.shape[:2], jnp.float32)
+        losses = optax.ctc_loss(logits, logit_paddings, labels,
+                                label_paddings)
+        loss = losses.mean()
+    elif is_text:
         _, targets, weights = batch
         if fused_xent:
             # Pallas blocked CE: one pass over the [tokens, vocab] logits
@@ -196,6 +206,7 @@ def build_train_step(
     the global batch; sharding/replication is handled inside.
     """
     is_text = spec.is_text
+    ctc = getattr(spec, "ctc", False)
     fuse = cfg.variable_update == "psum"
     from tpu_hc_bench.topology import DCN_AXIS, SEQ_AXIS as _SEQ
 
@@ -214,13 +225,14 @@ def build_train_step(
         raise ValueError("fabric=host has no multislice layout")
 
     if fab is fabric_mod.Fabric.HOST:
-        return _build_host_step(mesh, cfg, is_text)
+        return _build_host_step(mesh, cfg, is_text, ctc=ctc)
     if not sp and (tp or getattr(cfg, "expert_parallel", 1) > 1):
         # TP/EP run on the GSPMD arm: params enter committed with
         # tp_param_spec shardings and jit follows them
-        return _build_gspmd_step(mesh, cfg, is_text, follow_inputs=True)
+        return _build_gspmd_step(mesh, cfg, is_text, follow_inputs=True,
+                                 ctc=ctc)
     if not sp and cfg.variable_update == "replicated":
-        return _build_gspmd_step(mesh, cfg, is_text, dcn=dcn)
+        return _build_gspmd_step(mesh, cfg, is_text, dcn=dcn, ctc=ctc)
 
     # --sequence_parallel: same explicit-psum step over a (data, seq) mesh
     # — batch sharded over both axes, gradients reduced (with the same
@@ -252,7 +264,7 @@ def build_train_step(
 
         def loss_fn(p):
             return _loss_and_updates(state, p, batch, dropout_rng, is_text,
-                                      cfg.fused_xent)
+                                      cfg.fused_xent, ctc)
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -287,7 +299,7 @@ def build_train_step(
                 )
             loss, _ = _loss_and_updates(
                 state, state.params, batch, dropout_rng, is_text,
-                cfg.fused_xent,
+                cfg.fused_xent, ctc,
             )
             return state, {"loss": jax.lax.pmean(loss, axes)}
         device_step = fwd_only
@@ -317,7 +329,8 @@ def build_train_step(
 
 
 def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
-                      follow_inputs: bool = False, dcn: bool = False):
+                      follow_inputs: bool = False, dcn: bool = False,
+                      ctc: bool = False):
     """``--variable_update=replicated``: the pure-GSPMD arm.
 
     No shard_map, no explicit collectives: the step is written over the
@@ -337,13 +350,13 @@ def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
         if cfg.forward_only:
             loss, _ = _loss_and_updates(
                 state, state.params, batch, dropout_rng, is_text,
-                cfg.fused_xent,
+                cfg.fused_xent, ctc,
             )
             return state, {"loss": loss}
 
         def loss_fn(p):
             return _loss_and_updates(state, p, batch, dropout_rng, is_text,
-                                      cfg.fused_xent)
+                                      cfg.fused_xent, ctc)
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -374,7 +387,8 @@ def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
     )
 
 
-def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
+def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
+                     ctc: bool = False):
     """The `sock` path: grads computed per device, reduced through the host.
 
     Deliberately slow (device->host->device every step) but exercises the
@@ -389,7 +403,7 @@ def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool):
 
         def loss_fn(p):
             return _loss_and_updates(state, p, batch, dropout_rng, is_text,
-                                      cfg.fused_xent)
+                                      cfg.fused_xent, ctc)
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
