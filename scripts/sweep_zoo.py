@@ -53,6 +53,9 @@ DEFAULT_MATRIX = [
     ("gpt2_medium", 4),
     ("gpt2_moe", 16),
     ("llama_1b", 2),
+    # zoo completed round 3 (tf_cnn's last two members)
+    ("ncf", 65536),
+    ("deepspeech2", 16),
 ]
 
 # per-model extra flags (best-known single-chip configs, BASELINE.md)
