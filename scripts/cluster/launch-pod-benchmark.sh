@@ -27,7 +27,8 @@ command -v gcloud >/dev/null || { echo "gcloud CLI required" >&2; exit 1; }
 # forward HOROVOD_*/OMP_* through MPI).
 FWD=""
 for var in XLA_FLAGS LIBTPU_INIT_ARGS JAX_PLATFORMS TPU_HC_BENCH_SETENV \
-           JAX_TRACEBACK_FILTERING; do
+           JAX_TRACEBACK_FILTERING MODEL NUM_WARMUP NUM_BATCHES DATA_DIR \
+           EXTRA_FLAGS; do
     if [ -n "${!var:-}" ]; then
         FWD+="export $var=$(printf '%q' "${!var}"); "
     fi

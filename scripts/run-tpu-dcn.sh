@@ -27,6 +27,15 @@ NUM_BATCHES="${NUM_BATCHES:-100}"
 DATA_DIR_ARGS=()
 [ -n "${DATA_DIR:-}" ] && DATA_DIR_ARGS=(--data_dir "$DATA_DIR")
 
+# extra tf_cnn-style flags as a space-separated env string
+# (EXTRA_FLAGS="--eval True --train_dir /ckpts") — arrays don't cross the
+# env boundary, so this is the operator-facing contract.  Values may not
+# contain spaces (whitespace is the only separator); a sourced setenv
+# registry that already defines the EXTRA_ARGS array takes precedence.
+if [ -z "${EXTRA_ARGS+x}" ]; then
+    read -r -a EXTRA_ARGS <<< "${EXTRA_FLAGS:-}"
+fi
+
 mkdir -p "$HOME/logs"
 
 exec python -m tpu_hc_bench \
@@ -37,4 +46,4 @@ exec python -m tpu_hc_bench \
     --optimizer momentum \
     --display_every 10 \
     "${DATA_DIR_ARGS[@]}" \
-    "${EXTRA_ARGS[@]:-}"
+    ${EXTRA_ARGS[@]+"${EXTRA_ARGS[@]}"}
