@@ -25,15 +25,16 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 def pytest_addoption(parser):
     parser.addoption(
         "--runslow", action="store_true", default=False,
-        help="also run tests marked slow (whole-model param counts, "
-             "multi-process launches) — the full lane, ~25 min")
+        help="also run tests marked slow (whole-model param counts and "
+             "other heavyweight compiles) — the full lane; the true "
+             "multi-process tests are NOT slow-marked and always run")
 
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
-        "slow: heavyweight whole-model/multi-process test (runs only "
-        "with --runslow)")
+        "slow: heavyweight whole-model test (runs only with --runslow); "
+        "the multi-process suite is deliberately unmarked")
 
 
 def pytest_collection_modifyitems(config, items):
