@@ -52,6 +52,17 @@ def main(argv: list[str] | None = None) -> int:
         num_hosts, workers_per_host, fabric_name = None, 0, "ici"
     cfg = flags.parse_flags(rest)
 
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # On boxes with a tunneled-device plugin the JAX_PLATFORMS env var
+        # can lose to the plugin's registration priority; re-assert it
+        # through the config (which always wins) so the documented
+        # `JAX_PLATFORMS=cpu python -m tpu_hc_bench ...` contract holds.
+        # Must land before the first backend query (discover_layout).
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     if cfg.virtual_devices:
         # must land before the first backend query (discover_layout);
         # this jaxlib ignores --xla_force_host_platform_device_count

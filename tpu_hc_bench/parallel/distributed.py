@@ -66,6 +66,11 @@ def initialize(
     hosts = read_hostfile(hostfile or os.environ.get("TPU_HC_BENCH_HOSTFILE"))
     if process_id is None:
         process_id = int(os.environ["TPU_HC_BENCH_PROCESS_ID"])
+    if coordinator_port == DEFAULT_COORDINATOR_PORT:
+        # env override so colocated launches (tests, the scaling harness)
+        # can pick distinct ports without colliding on the default
+        coordinator_port = int(os.environ.get(
+            "TPU_HC_BENCH_COORDINATOR_PORT", coordinator_port))
     jax.distributed.initialize(
         coordinator_address=f"{hosts[0]}:{coordinator_port}",
         num_processes=len(hosts),

@@ -88,11 +88,43 @@ def host_allreduce(tree: Any, devices: list[jax.Device] | None = None) -> Any:
     training loop without ICI collectives and (b) give the fabric A/B
     comparison its slow arm, mirroring the reference's ib-vs-sock experiment
     (README.md:70-73).
+
+    Multi-process (world > 1): the stacked leaves are global jax.Arrays
+    whose shards span hosts, so each process reduces only its addressable
+    shards, then the partial sums cross hosts in ONE flat
+    ``process_allgather`` per call — the TCP hop of the reference's sock
+    fabric (gradient bytes leave the device fabric and transit host
+    memory + the coordinator network every step).
     """
     del devices
+    if jax.process_count() == 1:
+        def _reduce(leaf):
+            host = np.asarray(jax.device_get(leaf))
+            return np.mean(host, axis=0)
 
-    def _reduce(leaf):
-        host = np.asarray(jax.device_get(leaf))
-        return np.mean(host, axis=0)
+        return jax.tree.map(_reduce, tree)
 
-    return jax.tree.map(_reduce, tree)
+    from jax.experimental import multihost_utils
+
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    partial_sums, local_rows = [], None
+    for leaf in leaves:
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        rows = sum(s.shape[0] for s in shards)
+        if local_rows is None:
+            local_rows = rows
+        # sum over this host's slice of the device axis, in f32
+        partial_sums.append(
+            sum(s.sum(axis=0, dtype=np.float32) for s in shards))
+    flat = (np.concatenate([p.ravel() for p in partial_sums])
+            if partial_sums else np.zeros((0,), np.float32))
+    gathered = np.asarray(multihost_utils.process_allgather(flat))
+    total = gathered.sum(axis=0) / (local_rows * jax.process_count())
+    out, off = [], 0
+    for leaf, p in zip(leaves, partial_sums):
+        n = p.size
+        out.append(total[off:off + n].reshape(p.shape).astype(leaf.dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)
