@@ -67,11 +67,8 @@ def test_dcn_guards(mesh8):
         _run("ici", num_slices=2)
     with pytest.raises(ValueError, match="data parallelism only"):
         _run("dcn", num_slices=2, model_parallel=2)
-    cfg = flags.BenchmarkConfig(
-        model="trivial", num_classes=10, batch_size=2, eval=True,
-        num_batches=2, num_slices=2).resolve()
-    with pytest.raises(ValueError, match="not supported"):
-        driver.run_benchmark(cfg, fabric_name="dcn", print_fn=lambda _: None)
+    # --eval under multislice is no longer rejected:
+    # test_multislice_eval_matches_ici pins its parity with ICI eval
 
 
 def test_dcn_single_host_degenerates(mesh8):
@@ -79,3 +76,31 @@ def test_dcn_single_host_degenerates(mesh8):
     res, text = _run("dcn")
     assert "multislice" not in text
     assert np.isfinite(res.final_loss)
+
+
+def test_multislice_eval_matches_ici(mesh8, tmp_path):
+    """Round 4: --eval under multislice dcn — the (dcn, data) eval arm
+    reports the same accuracy/loss as plain ICI eval of the same
+    checkpoint (the hierarchical metric psum must equal the flat one)."""
+    train_dir = str(tmp_path / "ms_eval")
+    cfg = flags.BenchmarkConfig(
+        model="trivial", num_classes=10, batch_size=2,
+        num_warmup_batches=1, num_batches=3, display_every=1,
+        train_dir=train_dir).resolve()
+    driver.run_benchmark(cfg, print_fn=lambda _: None)
+
+    def run_eval(fabric, **kw):
+        out = []
+        cfg = flags.BenchmarkConfig(
+            model="trivial", num_classes=10, batch_size=2, eval=True,
+            num_warmup_batches=1, num_batches=2, display_every=1,
+            train_dir=train_dir, **kw).resolve()
+        res = driver.run_benchmark(cfg, fabric_name=fabric,
+                                   print_fn=out.append)
+        return res, [l for l in out if "top_1 accuracy" in l][0]
+
+    res_ici, top1_ici = run_eval("ici")
+    res_dcn, top1_dcn = run_eval("dcn", num_slices=2)
+    assert top1_dcn == top1_ici
+    np.testing.assert_allclose(res_dcn.final_loss, res_ici.final_loss,
+                               rtol=1e-5)

@@ -299,12 +299,14 @@ def _require_checkpoint_for_eval(cfg, restored: bool, print_fn) -> None:
 
 def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
               fab, print_fn, follow_inputs=False, eval_step=None,
-              sp=False):
+              sp=False, dcn=False, tp=False):
     """tf_cnn_benchmarks --eval: timed forward passes + top-1 accuracy.
 
     ``follow_inputs=True``: TP/EP eval — the state enters model-sharded
     and the GSPMD eval step follows its committed shardings.
-    ``sp=True``: the (data, seq) shard_map eval arm.
+    ``sp=True``: the (data, seq) shard_map eval arm (``tp=True`` for the
+    DP x SP x TP hybrid's partial-manual variant).
+    ``dcn=True``: the multislice (dcn, data) eval arm.
     ``eval_step``: pre-built override (the PP eval step) with the same
     ``(state, batch) -> (loss, correct)`` contract."""
     from tpu_hc_bench.train import step as step_mod
@@ -312,7 +314,7 @@ def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
     if eval_step is None:
         eval_step = step_mod.build_eval_step(mesh, cfg, spec,
                                              follow_inputs=follow_inputs,
-                                             sp=sp)
+                                             sp=sp, dcn=dcn, tp=tp)
     units = _example_units(cfg, spec)
     for _ in range(max(1, min(cfg.num_warmup_batches, 5))):
         loss, correct = eval_step(state, next(batch_iter))
@@ -397,26 +399,33 @@ def run_benchmark(
             "--expert_parallel composes with data parallelism only")
     mp = max(tp, ep) * pp * sp      # minor product = DP-degree divisor
     sharded_ckpt = False
+    pp_native_ckpt = False
     if cfg.train_dir and jax.process_count() > 1:
         # Plain-DP/SP state is REPLICATED (every host holds full copies:
         # process 0's device_get-and-save works, every process restores
-        # from the shared filesystem).  TP/EP states are model-SHARDED:
-        # they save/restore through Orbax's per-shard jax.Array I/O with
-        # every process participating (utils.checkpoint sharded=True).
-        # PP (and the SP hybrids) still restack through the DP-layout
-        # interchange, which needs full addressability: rejected.
-        if pp > 1 or (sp_active and max(tp, ep) > 1):
-            raise ValueError(
-                "--train_dir under a multi-host PP or SPxTP mesh is not "
-                "supported (the DP-layout checkpoint interchange needs "
-                "fully addressable arrays); train with --train_dir on a "
-                "single process or drop those flags")
-        sharded_ckpt = max(tp, ep) > 1
-        print_fn(
-            "--train_dir multi-process: "
-            + ("sharded Orbax I/O, every process writes its shards"
-               if sharded_ckpt else "process 0 writes")
-            + "; restore requires a filesystem shared by all hosts")
+        # from the shared filesystem).  TP/EP states — including the
+        # DP x SP x TP hybrid's — are model-SHARDED: they save/restore
+        # through Orbax's per-shard jax.Array I/O with every process
+        # participating (utils.checkpoint sharded=True, restore AFTER
+        # placement).  Multi-host PP (round 4) saves the PP-NATIVE
+        # stacked layout (utils.checkpoint.save_pp): the DP-layout
+        # interchange needs full addressability, which a pipe-sharded
+        # trunk is not, so the checkpoint keeps the [L, ...] layout and
+        # every process writes its shards.
+        if pp > 1:
+            pp_native_ckpt = True
+            print_fn(
+                "--train_dir multi-process PP: PP-native sharded Orbax "
+                "(stacked [L,...] trunk; not interchangeable with "
+                "DP-layout checkpoints); restore requires a filesystem "
+                "shared by all hosts")
+        else:
+            sharded_ckpt = max(tp, ep) > 1
+            print_fn(
+                "--train_dir multi-process: "
+                + ("sharded Orbax I/O, every process writes its shards"
+                   if sharded_ckpt else "process 0 writes")
+                + "; restore requires a filesystem shared by all hosts")
     if layout.total_workers % mp:
         raise ValueError(
             f"--model_parallel/--expert_parallel/--pipeline_parallel/"
@@ -444,8 +453,6 @@ def run_benchmark(
             raise ValueError(
                 "fabric=dcn multislice currently composes with data "
                 "parallelism only")
-        if num_slices > 1 and cfg.eval:
-            raise ValueError("--eval under multislice dcn is not supported")
     elif getattr(cfg, "num_slices", 0) > 1:
         raise ValueError("--num_slices requires fabric=dcn")
     mesh = build_mesh(layout, model_parallel=max(tp, ep),
@@ -472,9 +479,6 @@ def run_benchmark(
             raise ValueError(
                 f"sequence length {seq_len} not divisible by "
                 f"sequence_parallel={sp}")
-        if cfg.eval and tp > 1:
-            raise ValueError("--eval under the DPxSPxTP hybrid is not "
-                             "supported; evaluate under SP or TP alone")
 
     # real-data split, resolved ONCE: both the --num_epochs sizing and
     # the dataset construction below must read the same shards (eval
@@ -676,7 +680,8 @@ def run_benchmark(
         init_model = model.clone(attention_impl="dense", seq_axis=None)
         state = step_mod.make_train_state(init_model, cfg, batch)
         state = state.replace(apply_fn=model.apply)
-        state, sp_restored = _maybe_restore(state, cfg, print_fn)
+        if not sharded_ckpt:
+            state, sp_restored = _maybe_restore(state, cfg, print_fn)
         if tp > 1:
             # DP x SP x TP: params/opt model-sharded (auto axis), the SP
             # step's shard_map stays manual over data+seq only
@@ -684,15 +689,23 @@ def run_benchmark(
             state = step_mod.shard_state_tp(state, mesh)
         else:
             state = step_mod.replicate_state(state, mesh)
+        if sharded_ckpt:
+            # multi-host SP x TP (round 4): same restore-after-placement
+            # as the plain TP arm — Orbax reads each array straight into
+            # its committed model sharding
+            state, sp_restored = _maybe_restore(state, cfg, print_fn,
+                                                sharded=True)
         batch_iter = batches()
         if cfg.eval:
             # round 3: SP eval — the (data, seq) shard_map eval arm with
             # the shared text-metric formulas (exact global weighted
-            # mean), completing the eval matrix (DP/TP/EP/PP/SP)
+            # mean); round 4 extends it to the DP x SP x TP hybrid
+            # (partial-manual, model axis auto), completing the eval
+            # matrix (DP/TP/EP/PP/SP/hybrids)
             _require_checkpoint_for_eval(cfg, sp_restored, print_fn)
             return _run_eval(
                 cfg, spec, layout, mesh, state, batch_iter, global_batch,
-                fab, print_fn, sp=True,
+                fab, print_fn, sp=True, tp=tp > 1,
             )
         # the shared psum step builder handles SP (axes = (data, seq),
         # fusion buckets reduce over both)
@@ -725,35 +738,62 @@ def run_benchmark(
             print_fn(f"tensor parallel: {tp}-way (hybrid with PP)")
         pp_base_step = 0
         restored = False
-        if cfg.train_dir:
-            # DP<->DPxPP checkpoint interchange: restore the DP-layout
-            # checkpoint through a host-side abstract template (no device
-            # memory — PP models may not fit one device), restack the
-            # layer subtrees into the pipe-sharded trunk, re-place
-            pp_template = step_mod.abstract_train_state(model, cfg, batch)
-            restored_t, restored = _maybe_restore(pp_template, cfg, print_fn)
-            if restored:
-                pp_base_step = int(np.asarray(restored_t.step))
-                if cfg.eval:
-                    # forward-only: never restack or place the
-                    # params-sized momentum trace (a PP model may not fit
-                    # one device WITH it)
-                    params = pipe_mod.stack_layer_params(
-                        restored_t.params, model.num_layers)
-                    params = pipe_mod.place_pp_state(
-                        params, None, mesh, tp=tp > 1)
-                    opt_state = None
-                else:
-                    params, opt_state = pipe_mod.pp_state_from_train_state(
-                        restored_t, model.num_layers)
-                    params, opt_state = pipe_mod.place_pp_state(
-                        params, opt_state, mesh, tp=tp > 1)
-            pp_save_ctx = (model, pp_template, pp_base_step)
-        if not restored:
-            if cfg.eval:
-                _require_checkpoint_for_eval(cfg, restored, print_fn)
+        if pp_native_ckpt:
+            # multi-host PP (round 4): PP-native sharded checkpoints —
+            # init placed, then restore each array into its committed
+            # pipe/model sharding (utils.checkpoint.restore_pp); saves go
+            # through save_pp in save_now (no DP-layout interchange)
+            from tpu_hc_bench.utils import checkpoint as ckpt_mod
+
             params, opt_state = pipe_mod.make_pp_state(model, cfg, batch[0],
                                                        mesh, tp=tp > 1)
+            if ckpt_mod.latest_step(cfg.train_dir) is not None:
+                if cfg.eval:
+                    params, _, pp_base_step = ckpt_mod.restore_pp(
+                        params, None, cfg.train_dir)
+                    opt_state = None
+                else:
+                    params, opt_state, pp_base_step = ckpt_mod.restore_pp(
+                        params, opt_state, cfg.train_dir)
+                restored = True
+                print_fn(f"restored checkpoint step {pp_base_step} from "
+                         f"{cfg.train_dir} (PP-native)")
+            if cfg.eval:
+                _require_checkpoint_for_eval(cfg, restored, print_fn)
+        else:
+            if cfg.train_dir:
+                # DP<->DPxPP checkpoint interchange: restore the DP-layout
+                # checkpoint through a host-side abstract template (no
+                # device memory — PP models may not fit one device),
+                # restack the layer subtrees into the pipe-sharded trunk,
+                # re-place
+                pp_template = step_mod.abstract_train_state(model, cfg,
+                                                            batch)
+                restored_t, restored = _maybe_restore(pp_template, cfg,
+                                                      print_fn)
+                if restored:
+                    pp_base_step = int(np.asarray(restored_t.step))
+                    if cfg.eval:
+                        # forward-only: never restack or place the
+                        # params-sized momentum trace (a PP model may not
+                        # fit one device WITH it)
+                        params = pipe_mod.stack_layer_params(
+                            restored_t.params, model.num_layers)
+                        params = pipe_mod.place_pp_state(
+                            params, None, mesh, tp=tp > 1)
+                        opt_state = None
+                    else:
+                        params, opt_state = \
+                            pipe_mod.pp_state_from_train_state(
+                                restored_t, model.num_layers)
+                        params, opt_state = pipe_mod.place_pp_state(
+                            params, opt_state, mesh, tp=tp > 1)
+                pp_save_ctx = (model, pp_template, pp_base_step)
+            if not restored:
+                if cfg.eval:
+                    _require_checkpoint_for_eval(cfg, restored, print_fn)
+                params, opt_state = pipe_mod.make_pp_state(
+                    model, cfg, batch[0], mesh, tp=tp > 1)
         if cfg.eval:
             # round 3: PP eval — forward-only pipeline (deterministic),
             # same loss/top-1 arms as DP eval of the same checkpoint
@@ -790,9 +830,12 @@ def run_benchmark(
             _require_checkpoint_for_eval(cfg, restored, print_fn)
         batch_iter = batches()
         if cfg.eval:
+            # round 4: dcn=True is the multislice eval arm — the same
+            # (dcn, data) batch split + hierarchical metric psum as the
+            # multislice train step, forward-only
             return _run_eval(
                 cfg, spec, layout, mesh, state, batch_iter, global_batch,
-                fab, print_fn, follow_inputs=mp > 1,
+                fab, print_fn, follow_inputs=mp > 1, dcn=num_slices > 1,
             )
         train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
     rng = jax.random.PRNGKey(cfg.seed + 17)
@@ -830,6 +873,14 @@ def run_benchmark(
     timeline.start(metrics["loss"])
     warmup_steps = max(1, cfg.num_warmup_batches)
     def save_now(i: int) -> None:
+        if pp_native_ckpt:
+            from tpu_hc_bench.utils import checkpoint as ckpt_mod
+
+            p, o = state
+            path = ckpt_mod.save_pp(p, o, pp_base_step + warmup_steps + i,
+                                    cfg.train_dir)
+            print_fn(f"checkpoint saved: {path} (PP-native)")
+            return
         ctx = None
         if pp_save_ctx is not None:
             pp_model, pp_template, pp_base = pp_save_ctx

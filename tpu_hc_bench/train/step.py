@@ -432,10 +432,13 @@ def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
 
     def step(state, batch, rng):
         stacked_grads, losses, stacked_stats = grads_fn(state, batch, rng)
-        grads = fabric_mod.host_allreduce(stacked_grads)
-        stats = fabric_mod.host_allreduce(stacked_stats)
+        # ONE host reduce for grads+stats+loss: at world > 1 the stacked
+        # arrays span hosts, and host_allreduce is the only fetch that
+        # handles non-addressable shards (a bare device_get would throw)
+        grads, stats, loss = fabric_mod.host_allreduce(
+            (stacked_grads, stacked_stats, losses))
         state = apply_update(state, grads, stats)
-        return state, {"loss": jnp.asarray(np.mean(jax.device_get(losses)))}
+        return state, {"loss": jnp.asarray(loss)}
 
     return step
 
@@ -455,7 +458,8 @@ def weighted_text_metrics(logits, targets, weights):
 
 
 def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
-                    follow_inputs: bool = False, sp: bool = False):
+                    follow_inputs: bool = False, sp: bool = False,
+                    dcn: bool = False, tp: bool = False):
     """Eval step (tf_cnn_benchmarks --eval): forward pass, loss + top-1.
 
     Uses running BN statistics (``train=False``) and no dropout.  Returns
@@ -472,11 +476,26 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
     ``(data, seq)`` with the batch's [B, S] dims split over both axes and
     metrics psummed over both — same numbers as the DP arm by the shared
     ``weighted_text_metrics`` formulas.
+
+    ``dcn=True`` (round 4) is the multislice arm: the batch dim splits
+    over BOTH (dcn, data) and metrics psum hierarchically over them —
+    exactly the train step's multislice reduction, forward-only.
+
+    ``sp=True, tp=True`` (round 4) is the DP x SP x TP hybrid arm: the
+    same partial-manual shard_map as the hybrid train step — data/seq
+    stay manual (metric psums), the model axis stays auto, so the
+    committed model shardings of ``shard_state_tp`` flow through and
+    GSPMD inserts the Megatron all-reduces inside the manual body.
     """
     is_text = spec.is_text
-    from tpu_hc_bench.topology import SEQ_AXIS
+    from tpu_hc_bench.topology import DCN_AXIS, SEQ_AXIS
 
+    if dcn and sp:
+        raise ValueError("multislice eval composes with data parallelism "
+                         "only (matching the train step)")
     axes = (DATA_AXIS, SEQ_AXIS) if sp else (DATA_AXIS,)
+    if dcn:
+        axes = (DCN_AXIS,) + axes
 
     def device_eval(state: TrainState, batch):
         variables = {"params": state.params}
@@ -512,12 +531,19 @@ def build_eval_step(mesh: Mesh, cfg: BenchmarkConfig, spec: ModelSpec,
 
     if follow_inputs:
         return jax.jit(device_eval)
+    # multislice: the (dcn, data) pair splits the leading batch dim as one
+    # tuple group; SP splits batch dim 0 (data) and seq dim 1 separately
+    bspec = P((DCN_AXIS, DATA_AXIS)) if dcn else P(*axes)
+    manual: dict = {}
+    if sp and tp:
+        manual = {"axis_names": frozenset(axes)}
     shard_fn = jax.shard_map(
         device_eval,
         mesh=mesh,
-        in_specs=(P(), P(*axes)),
+        in_specs=(P(), bspec),
         out_specs=(P(), P()),
         check_vma=False,
+        **manual,
     )
     return jax.jit(shard_fn)
 
