@@ -21,10 +21,20 @@ command -v gcloud >/dev/null || { echo "gcloud CLI required" >&2; exit 1; }
 
 # 1. discovery -> hostfile contract (~/nodeips.txt, consumed by launchers
 #    exactly as mpirun consumed it, run-tf-sing-ucx-openmpi.sh:25,101)
-gcloud compute tpus tpu-vm describe "$POD" --zone="$ZONE" \
-    --format='value(networkEndpoints[].ipAddress)' \
-    | tr ';' '\n' > "$HOME/nodeips.txt"
-N=$(wc -l < "$HOME/nodeips.txt")
+# capture BEFORE touching the hostfile: a control-plane failure must never
+# leave a stale/empty nodeips.txt for a later launcher to consume
+IPS=$(gcloud compute tpus tpu-vm describe "$POD" --zone="$ZONE" \
+    --format='value(networkEndpoints[].ipAddress)') || {
+    echo "ERROR: gcloud describe failed for pod '$POD' (zone $ZONE)" >&2
+    exit 1
+}
+IPS=$(printf '%s\n' "$IPS" | tr ';' '\n' | sed '/^$/d')
+if [ -z "$IPS" ]; then
+    echo "ERROR: no host IPs discovered for pod '$POD' (zone $ZONE)" >&2
+    exit 1
+fi
+printf '%s\n' "$IPS" > "$HOME/nodeips.txt"
+N=$(printf '%s\n' "$IPS" | wc -l)
 echo "discovered $N hosts -> ~/nodeips.txt"
 
 # 2. software fan-out (replaces the O(N^2) sshpass key mesh: pod SSH is

@@ -1,0 +1,284 @@
+"""The cluster/ops shell layer under test — a gcloud PATH shim.
+
+The reference's L3/L5 scripts were operationally proven on real clusters
+(the nmap/sshpass mesh, `setup-pwdless-ssh.sh:37-54`; the pssh fan-out,
+`prep-cluster.sh:23-29`; the mpirun hostfile launch,
+`run-tf-sing-ucx-openmpi.sh:99-109`) but carried no automated coverage —
+and neither did our analogs in `scripts/cluster/` until this file.  A fake
+`gcloud` placed first on PATH records every invocation (argv preserved
+verbatim, one record per call) and emits canned control-plane output, so
+these tests assert, with no network and no cloud project:
+
+- `prep-cluster.sh` writes the right `~/nodeips.txt` (the hostfile
+  contract of `setup-pwdless-ssh.sh:32` that our launchers consume),
+  fans setup out to every worker, runs the per-host sanity check, and
+  fails LOUDLY (nonzero, no stale hostfile) on control-plane errors;
+- `launch-pod-benchmark.sh` assembles the right per-worker command
+  (the 4-positional `run-tpu-ici.sh` contract) and forwards the full
+  documented env list with values that survive shell quoting
+  (the `mpirun -x FOO` role, run-tf-sing-ucx-openmpi.sh:104-106);
+- the provisioners pass the right create flags and all scripts refuse
+  to run without their required arguments or without a gcloud CLI.
+
+No jax import here: pure subprocess tests, fast, in the default gate.
+"""
+
+import os
+import stat
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPTS = REPO / "scripts" / "cluster"
+
+SHIM = """#!/usr/bin/env bash
+# fake gcloud: log argv verbatim, emit canned control-plane output
+log="${GCLOUD_SHIM_LOG:?shim needs GCLOUD_SHIM_LOG}"
+{
+  echo "==CALL=="
+  printf '%s\\n' "$@"
+} >> "$log"
+for arg in "$@"; do
+  if [ "$arg" = "${GCLOUD_SHIM_FAIL:-__never__}" ]; then
+    echo "fake gcloud: simulated $arg failure" >&2
+    exit 1
+  fi
+done
+case " $* " in
+  *" describe "*) echo "${GCLOUD_SHIM_IPS-10.0.0.1;10.0.0.2;10.0.0.3;10.0.0.4}" ;;
+esac
+exit 0
+"""
+
+
+def _make_shim(tmp_path):
+    """Install the fake gcloud first on PATH; return (env, log_path)."""
+    bin_dir = tmp_path / "shimbin"
+    bin_dir.mkdir()
+    shim = bin_dir / "gcloud"
+    shim.write_text(SHIM)
+    shim.chmod(shim.stat().st_mode | stat.S_IXUSR)
+    log = tmp_path / "gcloud_calls.log"
+    home = tmp_path / "home"
+    home.mkdir()
+    env = dict(os.environ)
+    env.update({
+        "PATH": f"{bin_dir}:{env['PATH']}",
+        "GCLOUD_SHIM_LOG": str(log),
+        "HOME": str(home),
+    })
+    return env, log, home
+
+
+def _calls(log: Path) -> list[list[str]]:
+    """Parse the shim log back into one argv list per gcloud invocation."""
+    if not log.exists():
+        return []
+    records = log.read_text().split("==CALL==\n")
+    return [rec.splitlines() for rec in records if rec]
+
+
+def _run(script, args, env, **kw):
+    return subprocess.run(
+        ["bash", str(SCRIPTS / script), *args],
+        env=env, capture_output=True, text=True, timeout=60, **kw)
+
+
+# ---------------------------------------------------------------- prep-cluster
+
+def test_prep_cluster_writes_hostfile_contract(tmp_path):
+    env, log, home = _make_shim(tmp_path)
+    r = _run("prep-cluster.sh", ["mypod", "us-east5-a"], env)
+    assert r.returncode == 0, r.stderr
+    # the hostfile contract: one IP per line, exactly the endpoints the
+    # control plane reported (semicolon-joined in gcloud value format)
+    hostfile = home / "nodeips.txt"
+    assert hostfile.read_text() == "10.0.0.1\n10.0.0.2\n10.0.0.3\n10.0.0.4\n"
+    assert "discovered 4 hosts" in r.stdout
+    calls = _calls(log)
+    describe = calls[0]
+    assert describe[:5] == ["compute", "tpus", "tpu-vm", "describe", "mypod"]
+    assert "--zone=us-east5-a" in describe
+    assert "--format=value(networkEndpoints[].ipAddress)" in describe
+    # no repo-url arg -> no fan-out clone; the per-host sanity check still
+    # runs on every worker (the `pssh ibv_devinfo | grep state` analog)
+    sanity = calls[-1]
+    assert "ssh" in sanity and "--worker=all" in sanity
+    cmd = sanity[sanity.index("--command") + 1] if "--command" in sanity \
+        else next(a for a in sanity if "sanity" in a)
+    assert "python -m tpu_hc_bench.utils.sanity" in cmd
+    assert len(calls) == 2
+
+
+def test_prep_cluster_repo_fanout(tmp_path):
+    env, log, _ = _make_shim(tmp_path)
+    r = _run("prep-cluster.sh",
+             ["mypod", "us-east5-a", "https://example.com/repo.git"], env)
+    assert r.returncode == 0, r.stderr
+    calls = _calls(log)
+    assert len(calls) == 3          # describe, clone fan-out, sanity
+    clone = calls[1]
+    assert "--worker=all" in clone
+    joined = "\n".join(clone)
+    assert "git clone https://example.com/repo.git" in joined
+    assert "setup-tpu-vm.sh stable" in joined
+
+
+def test_prep_cluster_single_host_pod(tmp_path):
+    env, _, home = _make_shim(tmp_path)
+    env["GCLOUD_SHIM_IPS"] = "10.1.2.3"     # v5litepod-1: no semicolons
+    r = _run("prep-cluster.sh", ["solo"], env)
+    assert r.returncode == 0, r.stderr
+    assert (home / "nodeips.txt").read_text() == "10.1.2.3\n"
+
+
+def test_prep_cluster_fails_loudly_on_describe_error(tmp_path):
+    env, _, home = _make_shim(tmp_path)
+    env["GCLOUD_SHIM_FAIL"] = "describe"
+    r = _run("prep-cluster.sh", ["mypod"], env)
+    assert r.returncode != 0
+    # a failed discovery must not leave a stale/empty hostfile for a later
+    # launcher to consume
+    assert not (home / "nodeips.txt").exists()
+
+
+def test_prep_cluster_fails_loudly_on_empty_discovery(tmp_path):
+    env, _, home = _make_shim(tmp_path)
+    env["GCLOUD_SHIM_IPS"] = ""
+    r = _run("prep-cluster.sh", ["ghostpod"], env)
+    assert r.returncode != 0
+    assert "no host IPs discovered" in r.stderr
+    assert not (home / "nodeips.txt").exists()
+
+
+def test_prep_cluster_requires_pod_name(tmp_path):
+    env, _, _ = _make_shim(tmp_path)
+    r = _run("prep-cluster.sh", [], env)
+    assert r.returncode != 0
+    assert "usage" in r.stderr
+
+
+# ------------------------------------------------------- launch-pod-benchmark
+
+def test_launch_pod_benchmark_command_assembly(tmp_path):
+    env, log, _ = _make_shim(tmp_path)
+    r = _run("launch-pod-benchmark.sh",
+             ["mypod", "us-east5-a", "2", "0", "64", "ici"], env)
+    assert r.returncode == 0, r.stderr
+    calls = _calls(log)
+    assert len(calls) == 1
+    ssh = calls[0]
+    assert ssh[:4] == ["compute", "tpus", "tpu-vm", "ssh"]
+    assert "mypod" in ssh and "--zone=us-east5-a" in ssh
+    assert "--worker=all" in ssh
+    cmd = next(a for a in ssh if a.startswith("--command="))
+    # the per-worker command: the literal 4-positional launcher contract
+    assert "./scripts/run-tpu-ici.sh 2 0 64 ici" in cmd
+    # every worker sources the setenv registry first (host/container
+    # symmetry of the reference's /mnt/shared/setenv)
+    assert "source ${TPU_HC_BENCH_SETENV:-$HOME/.tpu_hc_bench/setenv}" in cmd
+
+
+def test_launch_pod_benchmark_forwards_full_env_list(tmp_path):
+    env, log, _ = _make_shim(tmp_path)
+    # every var in the documented forwarding list, with values that break
+    # naive quoting (spaces, equals signs) — the `mpirun -x` contract
+    fwd = {
+        "XLA_FLAGS": "--xla_flag_a=1 --xla_flag_b=2",
+        "LIBTPU_INIT_ARGS": "--arg with spaces",
+        "JAX_PLATFORMS": "tpu",
+        "TPU_HC_BENCH_SETENV": "/opt/custom/setenv",
+        "JAX_TRACEBACK_FILTERING": "off",
+        "MODEL": "resnet50",
+        "NUM_WARMUP": "50",
+        "NUM_BATCHES": "100",
+        "DATA_DIR": "/mnt/data dir/tfrecords",
+        "EXTRA_FLAGS": "--model_parallel=2 --eval",
+    }
+    env.update(fwd)
+    r = _run("launch-pod-benchmark.sh",
+             ["mypod", "us-east5-a", "4", "0", "128", "dcn"], env)
+    assert r.returncode == 0, r.stderr
+    cmd = next(a for a in _calls(log)[0] if a.startswith("--command="))
+    for var, val in fwd.items():
+        assert f"export {var}=" in cmd, f"{var} not forwarded"
+        # the %q-quoted value must round-trip through a shell eval
+        check = subprocess.run(
+            ["bash", "-c",
+             cmd[len("--command="):].split("cd tpu-hc-bench")[0]
+             + f'printf %s "${var}"'],
+            capture_output=True, text=True, timeout=30,
+            env={"PATH": os.environ["PATH"], "HOME": str(tmp_path)})
+        assert check.stdout == val, (var, check.stdout, val)
+    assert "./scripts/run-tpu-ici.sh 4 0 128 dcn" in cmd
+
+
+def test_launch_pod_benchmark_omits_unset_env(tmp_path):
+    env, log, _ = _make_shim(tmp_path)
+    for var in ("XLA_FLAGS", "MODEL", "EXTRA_FLAGS", "DATA_DIR"):
+        env.pop(var, None)
+    r = _run("launch-pod-benchmark.sh",
+             ["mypod", "z", "1", "0", "32", "ici"], env)
+    assert r.returncode == 0, r.stderr
+    cmd = next(a for a in _calls(log)[0] if a.startswith("--command="))
+    assert "export XLA_FLAGS" not in cmd
+    assert "export MODEL" not in cmd
+
+
+def test_launch_pod_benchmark_requires_all_positionals(tmp_path):
+    env, _, _ = _make_shim(tmp_path)
+    r = _run("launch-pod-benchmark.sh", ["mypod", "zone", "2"], env)
+    assert r.returncode != 0
+
+
+# ------------------------------------------------------------- provisioners
+
+def test_create_tpu_vm_flags(tmp_path):
+    env, log, _ = _make_shim(tmp_path)
+    r = _run("create-tpu-vm.sh", ["node1"], env)
+    assert r.returncode == 0, r.stderr
+    create = _calls(log)[0]
+    assert create[:5] == ["compute", "tpus", "tpu-vm", "create", "node1"]
+    assert "--accelerator-type=v5litepod-1" in create
+    assert "--zone=us-central2-b" in create
+    assert any(a.startswith("--version=") for a in create)
+
+
+def test_create_tpu_pod_north_star_default(tmp_path):
+    env, log, _ = _make_shim(tmp_path)
+    r = _run("create-tpu-pod.sh", ["pod1", "eu-west4-b"], env)
+    assert r.returncode == 0, r.stderr
+    create = _calls(log)[0]
+    # BASELINE north star hardware: v5e-32
+    assert "--accelerator-type=v5litepod-32" in create
+    assert "--zone=eu-west4-b" in create
+
+
+def test_create_scripts_require_name(tmp_path):
+    env, _, _ = _make_shim(tmp_path)
+    for script in ("create-tpu-vm.sh", "create-tpu-pod.sh"):
+        r = _run(script, [], env)
+        assert r.returncode != 0
+        assert "usage" in r.stderr
+
+
+def test_scripts_require_gcloud_cli(tmp_path):
+    """Without any gcloud on PATH every script refuses loudly (this box
+    has a real /usr/bin/gcloud, so build a minimal PATH that excludes it
+    but keeps the coreutils the scripts need)."""
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    for tool in ("bash", "env", "tr", "wc", "sed", "rm", "printf", "echo"):
+        src = Path("/usr/bin") / tool
+        if not src.exists():
+            src = Path("/bin") / tool
+        (tools / tool).symlink_to(src)
+    env = {"PATH": str(tools), "HOME": str(tmp_path)}
+    for script, args in (
+            ("prep-cluster.sh", ["pod"]),
+            ("launch-pod-benchmark.sh", ["pod", "z", "1", "0", "32", "ici"]),
+            ("create-tpu-vm.sh", ["n"]),
+            ("create-tpu-pod.sh", ["n"])):
+        r = _run(script, args, env)
+        assert r.returncode != 0, script
+        assert "gcloud CLI required" in r.stderr, script

@@ -10,11 +10,15 @@ TPU-first choices:
 
 - **Conv frontend as NHWC**: the spectrogram runs as a [B, T, F, C]
   image so the big 41x11/21x11 kernels land on the MXU like any CNN.
-- **GRUs as `lax.scan`** (``flax.linen.RNN``/``Bidirectional``): the
-  recurrence compiles to a single fused scan per direction — XLA's
-  preferred RNN form — with all gate matmuls batched per step.  RNNs are
-  inherently latency-bound on wide accelerators; this member exists for
-  coverage, and its MFU ceiling is the recurrence, not the harness.
+- **GRUs as `lax.scan` with hoisted input projections** (``HoistedGRU``,
+  the round-4 default): the three input-gate matmuls do not depend on
+  the carry, so they run for the whole utterance as ONE [B*T, I]x[I, 3H]
+  MXU matmul before the scan; the recurrence carries only the fused
+  [B, H]x[H, 3H] hidden matmul + gate nonlinearities — the canonical
+  RNN-on-accelerator layout.  ``rnn_impl="flax"`` keeps the plain
+  ``flax.linen.RNN``/``Bidirectional`` form as the A/B control.  RNNs
+  remain latency-bound on wide accelerators; the hoist moves the bound,
+  it does not remove it.
 - **CTC via ``optax.ctc_loss``** (the driver's ``ctc`` loss arm): the
   forward-backward recursion is an XLA scan over logit frames, batched.
 
@@ -29,6 +33,7 @@ from __future__ import annotations
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 # 26 letters + space + apostrophe + CTC blank (id 0)
@@ -47,12 +52,68 @@ def max_label_for(frames: int) -> int:
     return min(DS2_MAX_LABEL, frames // DS2_TIME_STRIDE - 4)
 
 
+class HoistedGRU(nn.Module):
+    """GRU layer with the input projections hoisted out of the scan.
+
+    ``flax.linen.RNN(GRUCell)`` computes all six gate matmuls inside the
+    recurrence, so the three input projections (which do not depend on the
+    carry) re-dispatch as [B, I]x[I, H] matmuls T times.  The canonical
+    RNN-on-accelerator layout computes them for the WHOLE utterance up
+    front — one [B*T, I]x[I, 3H] MXU matmul — and the scan carries only
+    the hidden-to-hidden [B, H]x[H, 3H] matmul plus the gate nonlinearity.
+    Same math as flax's GRUCell (sigmoid r/z gates, tanh candidate with
+    reset applied to the hidden projection, ``h' = (1-z)*n + z*h``), so a
+    param-copy parity test pins equivalence (tests/test_models.py).
+
+    Gate order in the fused 3H axis: [r | z | n].
+    """
+
+    hidden: int
+    reverse: bool = False       # bwd direction of a BiGRU: scan T-1..0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, i = x.shape
+        h = self.hidden
+        dense = lambda feats, name, bias: nn.Dense(
+            feats, use_bias=bias, dtype=self.dtype, name=name)
+        # [B, T, 3H] in one batched matmul (biases b_ir/b_iz/b_in fused)
+        xg = dense(3 * h, "input_gates", True)(x)
+        # hidden-to-hidden: fused [H, 3H] kernel, no bias on r/z (flax
+        # GRUCell convention), bias only on the candidate's hidden part
+        wh = self.param("hidden_gates",
+                        nn.initializers.orthogonal(column_axis=-1),
+                        (h, 3 * h), jnp.float32).astype(self.dtype)
+        bn = self.param("candidate_bias", nn.initializers.zeros_init(),
+                        (h,), jnp.float32).astype(self.dtype)
+
+        def step(carry, xg_t):
+            hg = carry @ wh
+            xr, xz, xn = jnp.split(xg_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = nn.sigmoid(xr + hr)
+            z = nn.sigmoid(xz + hz)
+            n = nn.tanh(xn + r * (hn + bn))
+            new_h = (1.0 - z) * n + z * carry
+            return new_h, new_h
+
+        h0 = jnp.zeros((b, h), self.dtype)
+        _, ys = jax.lax.scan(step, h0, xg.transpose(1, 0, 2),
+                             reverse=self.reverse)
+        return ys.transpose(1, 0, 2)        # [B, T, H]
+
+
 class DeepSpeech2(nn.Module):
     vocab_size: int = DS2_VOCAB
     rnn_hidden: int = 800
     num_rnn_layers: int = 5
     conv_channels: int = 32
     dtype: Any = jnp.float32
+    rnn_impl: str = "hoisted"   # hoisted (input projections batched out
+                                # of the scan) | flax (linen.RNN/GRUCell,
+                                # all gates inside the recurrence) — the
+                                # round-4 A/B pair
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -71,12 +132,21 @@ class DeepSpeech2(nn.Module):
         x = x.reshape(b, t, f * c)
 
         for i in range(self.num_rnn_layers):
-            cell = lambda n: nn.RNN(nn.GRUCell(self.rnn_hidden,
-                                               dtype=self.dtype), name=n)
-            y = nn.Bidirectional(
-                cell(f"gru{i}_fwd"), cell(f"gru{i}_bwd"),
-                merge_fn=lambda a, b: a + b,        # DS2 sum-merge
-                name=f"bigru{i}")(x)
+            if self.rnn_impl == "hoisted":
+                y = (HoistedGRU(self.rnn_hidden, dtype=self.dtype,
+                                name=f"gru{i}_fwd")(x)
+                     + HoistedGRU(self.rnn_hidden, dtype=self.dtype,
+                                  reverse=True, name=f"gru{i}_bwd")(x))
+            elif self.rnn_impl == "flax":
+                cell = lambda n: nn.RNN(nn.GRUCell(self.rnn_hidden,
+                                                   dtype=self.dtype),
+                                        name=n)
+                y = nn.Bidirectional(
+                    cell(f"gru{i}_fwd"), cell(f"gru{i}_bwd"),
+                    merge_fn=lambda a, b: a + b,    # DS2 sum-merge
+                    name=f"bigru{i}")(x)
+            else:
+                raise ValueError(f"unknown rnn_impl {self.rnn_impl!r}")
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              epsilon=1e-5, dtype=self.dtype,
                              name=f"rnn{i}_bn")(y)
@@ -85,14 +155,16 @@ class DeepSpeech2(nn.Module):
                         name="ctc_head")(x)
 
 
-def deepspeech2(num_classes: int = DS2_VOCAB, dtype=jnp.float32):
+def deepspeech2(num_classes: int = DS2_VOCAB, dtype=jnp.float32,
+                rnn_impl: str = "hoisted"):
     """DS2 at the paper/tf_cnn shape (5x800 summed BiGRU, ~48M params)."""
     del num_classes
-    return DeepSpeech2(dtype=dtype)
+    return DeepSpeech2(dtype=dtype, rnn_impl=rnn_impl)
 
 
-def deepspeech2_tiny(num_classes: int = DS2_VOCAB, dtype=jnp.float32):
+def deepspeech2_tiny(num_classes: int = DS2_VOCAB, dtype=jnp.float32,
+                     rnn_impl: str = "hoisted"):
     """2x32 BiGRU variant for tests/CPU smoke runs."""
     del num_classes
     return DeepSpeech2(rnn_hidden=32, num_rnn_layers=2, conv_channels=4,
-                       dtype=dtype)
+                       dtype=dtype, rnn_impl=rnn_impl)

@@ -127,6 +127,13 @@ class MoEFFN(nn.Module):
                                        # run as a lax.map over chunks so
                                        # Mosaic's scoped-VMEM tiling never
                                        # sees an oversized operand
+    ragged_f_chunk: int = 1024         # ragged path: tile the FFN (F) dim
+                                       # of the [E,H,F]/[E,F,H] weights so
+                                       # each grouped matmul's weight block
+                                       # fits Mosaic's scoped VMEM (round-3
+                                       # failure: 19.4M > 16M on the full
+                                       # [8,3072,768] contraction at
+                                       # bs=16/seq=1024); 0 disables
 
     @nn.compact
     def __call__(self, x):
@@ -191,8 +198,7 @@ class MoEFFN(nn.Module):
         if total <= self.ragged_chunk:
             group_sizes = jnp.bincount(pair_expert, length=e).astype(
                 jnp.int32)
-            h1 = nn.gelu(jax.lax.ragged_dot(xs, wi_c, group_sizes))
-            out = jax.lax.ragged_dot(h1, wo_c, group_sizes)
+            out = self._grouped_ffn(xs, group_sizes, wi_c, wo_c)
         else:
             # chunked grouped matmuls (round 2): big batchxseq blew past
             # Mosaic's scoped-VMEM tiling limit (BASELINE.md r1: 19.4M >
@@ -212,8 +218,7 @@ class MoEFFN(nn.Module):
 
             def body(args):
                 xc, sz = args
-                h1 = nn.gelu(jax.lax.ragged_dot(xc, wi_c, sz))
-                return jax.lax.ragged_dot(h1, wo_c, sz)
+                return self._grouped_ffn(xc, sz, wi_c, wo_c)
 
             out = jax.lax.map(body, (xs_p.reshape(chunks, chunk, h), sizes))
             out = out.reshape(chunks * chunk, h)[:total]
@@ -223,3 +228,39 @@ class MoEFFN(nn.Module):
         out = out[inv].reshape(n, k, h)
         y = (out * gates[..., None].astype(self.dtype)).sum(axis=1)
         return y.reshape(b, s, h), aux
+
+    def _grouped_ffn(self, xs, sizes, wi_c, wo_c):
+        """Expert FFN over one expert-sorted row block: two grouped
+        matmuls, with the FFN dim tiled to ``ragged_f_chunk``.
+
+        The full-width contraction hands Mosaic a [E, F, H] weight block
+        whose scoped-VMEM footprint scales with F (the round-3 bs=16
+        failure); slicing F keeps every ragged_dot's weight tile small
+        while the row dim stays the whole (expert-sorted) chunk.  gelu is
+        elementwise over F, so per-slice activation is exact, and the
+        second matmul's F-contraction distributes over slices as a sum —
+        a lax.scan accumulates it without materializing [rows, F].
+        """
+        f = wi_c.shape[-1]
+        fc = self.ragged_f_chunk
+        if not fc or f <= fc:
+            h1 = nn.gelu(jax.lax.ragged_dot(xs, wi_c, sizes))
+            return jax.lax.ragged_dot(h1, wo_c, sizes)
+        e, h = wi_c.shape[0], wi_c.shape[1]
+        pad = (-f) % fc
+        if pad:
+            # zero-pad F: gelu(0)=0 and wo's zero rows contribute 0
+            wi_c = jnp.pad(wi_c, ((0, 0), (0, 0), (0, pad)))
+            wo_c = jnp.pad(wo_c, ((0, 0), (0, pad), (0, 0)))
+        nf = (f + pad) // fc
+        wi_t = wi_c.reshape(e, h, nf, fc).transpose(2, 0, 1, 3)
+        wo_t = wo_c.reshape(e, nf, fc, h).transpose(1, 0, 2, 3)
+
+        def slice_body(acc, ws):
+            wi_s, wo_s = ws
+            h1 = nn.gelu(jax.lax.ragged_dot(xs, wi_s, sizes))
+            return acc + jax.lax.ragged_dot(h1, wo_s, sizes), None
+
+        acc0 = jnp.zeros((xs.shape[0], h), self.dtype)
+        out, _ = jax.lax.scan(slice_body, acc0, (wi_t, wo_t))
+        return out
