@@ -1,0 +1,111 @@
+"""Round-4 A/B: deepspeech2 hoisted-GRU vs flax RNN(GRUCell) (VERDICT #3).
+
+Round 3 recorded deepspeech2 at 6.4% MFU with the GRU input projections
+computed INSIDE the scan (flax.linen.RNN/GRUCell) and called it "the
+known RNN ceiling" — one step early, per the verdict: hoisting the
+[T, B, 3H] input-gate matmuls out of the recurrence into one big MXU
+matmul is the canonical RNN-on-accelerator optimization and had not been
+tried.  models/deepspeech.HoistedGRU is that hoist (param-copy parity
+with GRUCell pinned in tests/test_models.py); this experiment measures
+it whole-model on hardware.
+
+Protocol (env notes in memory): both arms build + compile ONCE in one
+process, then timed segments interleave C V C V C V C (C = flax control,
+V = hoisted variant) so chip drift cancels — each variant segment is
+scored against the mean of its bracketing controls, and the reported
+speedup is the MEDIAN of those ratios.  Sync is a value fetch
+(jax.device_get), never block_until_ready, per the tunnel rules.
+
+Usage: python scripts/exp_ds2_hoist.py [batch] [steps_per_segment] [reps]
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tpu_hc_bench import flags
+from tpu_hc_bench.data.synthetic import SyntheticSpeech
+from tpu_hc_bench.models import create_model
+from tpu_hc_bench.models.deepspeech import max_label_for
+from tpu_hc_bench.topology import build_mesh, discover_layout
+from tpu_hc_bench.train import step as step_mod
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+STEPS = int(sys.argv[2]) if len(sys.argv) > 2 else 60
+REPS = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+
+
+def build_arm(rnn_impl: str, mesh, cfg, batch):
+    model, spec = create_model("deepspeech2", dtype=jnp.bfloat16,
+                               rnn_impl=rnn_impl)
+    state = step_mod.make_train_state(model, cfg, batch)
+    state = step_mod.replicate_state(state, mesh)
+    train_step = step_mod.build_train_step(mesh, cfg, spec)
+    dev_batch = step_mod.shard_batch(batch, mesh)
+    rng = jax.random.PRNGKey(1)
+
+    def segment(state, n):
+        metrics = None
+        for i in range(n):
+            state, metrics = train_step(state, dev_batch,
+                                        jax.random.fold_in(rng, i))
+        return state, metrics
+
+    return state, segment
+
+
+def main():
+    cfg = flags.BenchmarkConfig(model="deepspeech2",
+                                batch_size=BATCH).resolve()
+    layout = discover_layout()
+    mesh = build_mesh(layout)
+    frames, freq = 300, 161
+    batch = SyntheticSpeech(BATCH * layout.total_workers, frames, freq,
+                            max_label_for(frames), seed=0).batch()
+
+    arms = {}
+    for impl in ("flax", "hoisted"):
+        t0 = time.perf_counter()
+        state, seg = build_arm(impl, mesh, cfg, batch)
+        state, metrics = seg(state, 3)           # compile + warm
+        loss = float(jax.device_get(metrics["loss"]))
+        print(f"{impl}: compiled+warm in {time.perf_counter()-t0:.1f}s "
+              f"loss={loss:.3f}", flush=True)
+        arms[impl] = (state, seg)
+
+    def timed(impl):
+        state, seg = arms[impl]
+        state, m0 = seg(state, 1)                # state is DONATED: carry it
+        jax.device_get(m0["loss"])               # sync start
+        t0 = time.perf_counter()
+        state, m = seg(state, STEPS)
+        jax.device_get(m["loss"])                # sync end (value fetch)
+        dt = time.perf_counter() - t0
+        arms[impl] = (state, seg)
+        rate = STEPS * BATCH * layout.total_workers / dt
+        print(f"  {impl:8s} {1e3*dt/STEPS:7.2f} ms/step "
+              f"{rate:8.1f} ex/s", flush=True)
+        return rate
+
+    controls, variants = [], []
+    controls.append(timed("flax"))
+    for _ in range(REPS):
+        variants.append(timed("hoisted"))
+        controls.append(timed("flax"))
+    ratios = [v / ((controls[i] + controls[i + 1]) / 2)
+              for i, v in enumerate(variants)]
+    print(f"controls (flax): {[f'{c:.1f}' for c in controls]}")
+    print(f"variants (hoisted): {[f'{v:.1f}' for v in variants]}")
+    print(f"ratios: {[f'{r:.3f}' for r in ratios]}")
+    print(f"MEDIAN hoisted/flax speedup: {statistics.median(ratios):.3f}x")
+    print(f"hoisted median rate: {statistics.median(variants):.1f} ex/s; "
+          f"flax median rate: {statistics.median(controls):.1f} ex/s")
+
+
+if __name__ == "__main__":
+    main()

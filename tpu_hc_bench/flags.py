@@ -180,6 +180,11 @@ class BenchmarkConfig:
                                               # ceil(cf*k*S/E): the
                                               # token-drop pressure valve
                                               # for long-context MoE
+    rnn_impl: str = "hoisted"                 # hoisted|flax: RNN members'
+                                              # GRU form (hoisted = input
+                                              # projections batched out of
+                                              # the scan; flax = linen.RNN
+                                              # A/B control)
     train_dir: str | None = None              # tf_cnn_benchmarks --train_dir:
                                               # save checkpoints here during
                                               # training; --eval restores the
@@ -464,6 +469,8 @@ def build_parser() -> argparse.ArgumentParser:
                             "ulysses_flash"])
     p.add_argument("--moe_impl", type=str, default=d.moe_impl,
                    choices=["auto", "einsum", "ragged"])
+    p.add_argument("--rnn_impl", type=str, default=d.rnn_impl,
+                   choices=["hoisted", "flax"])
     return p
 
 

@@ -207,9 +207,15 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                  gradient_checkpointing: bool = False,
                  moe_impl: str = "einsum", seq_axis: str | None = None,
                  moe_capacity_factor: float = 1.25,
-                 fused_conv: bool = False):
+                 fused_conv: bool = False, rnn_impl: str = "hoisted"):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
+    if getattr(spec, "ctc", False):
+        # RNN members: hoisted (input projections batched out of the
+        # scan, the round-4 default) vs flax (linen.RNN A/B control)
+        kwargs["rnn_impl"] = rnn_impl
+    elif rnn_impl != "hoisted":
+        raise ValueError(f"--rnn_impl only applies to RNN members, not {name}")
     if spec.moe:
         kwargs["moe_impl"] = moe_impl
         kwargs["moe_capacity_factor"] = moe_capacity_factor

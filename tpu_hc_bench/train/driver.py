@@ -470,6 +470,7 @@ def run_benchmark(
                                seq_len=cfg.seq_len,
                                gradient_checkpointing=cfg.gradient_checkpointing,
                                moe_impl=getattr(cfg, "moe_impl", "einsum"),
+                               rnn_impl=getattr(cfg, "rnn_impl", "hoisted"),
                                moe_capacity_factor=getattr(
                                    cfg, "moe_capacity_factor", 1.25),
                                seq_axis=SEQ_AXIS if sp_active else None)
