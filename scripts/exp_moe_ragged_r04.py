@@ -1,0 +1,150 @@
+"""Round-4 MoE ragged re-contest (VERDICT #4): F-tiled grouped matmuls.
+
+Round 3 left two open wounds on the ragged (grouped-matmul) MoE path:
+bs=16/seq=1024 could not run at all (Mosaic scoped-VMEM 19.4M > 16M on
+the full [8,3072,768] contraction), and ragged LOST to the O(S^2) einsum
+dispatch at seq 1024 (31.2 vs 49.2 ex/s at bs=8) — a grouped matmul with
+zero capacity padding losing to dense dispatch means the kernel's
+tiling, not the algorithm, was the bottleneck.  models/moe.py now tiles
+the FFN dim (`ragged_f_chunk`), so this experiment:
+
+1. proves bs=16/seq=1024 ragged RUNS (the former Mosaic failure);
+2. sweeps ragged_f_chunk at the contested shape;
+3. re-runs the einsum-vs-ragged crossover at seq 1024 with the tiled
+   kernel, drift-paired (einsum control brackets each ragged segment,
+   median of ratios).
+
+Whole-model gpt2_moe train steps, bf16, flash attention — the exact
+round-3 measurement config (BASELINE.md MoE section).
+
+Usage: python scripts/exp_moe_ragged_r04.py [seq] [batch] [steps] [reps]
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tpu_hc_bench import flags
+from tpu_hc_bench.data.synthetic import SyntheticTokens
+from tpu_hc_bench.models import create_model
+from tpu_hc_bench.topology import build_mesh, discover_layout
+from tpu_hc_bench.train import step as step_mod
+
+SEQ = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+STEPS = int(sys.argv[3]) if len(sys.argv) > 3 else 30
+REPS = int(sys.argv[4]) if len(sys.argv) > 4 else 3
+
+
+def build_arm(moe_impl: str, mesh, layout, f_chunk: int | None = None):
+    cfg = flags.BenchmarkConfig(model="gpt2_moe", batch_size=BATCH,
+                                seq_len=SEQ, use_fp16=True,
+                                attention_impl="flash",
+                                moe_impl=moe_impl).resolve()
+    model, spec = create_model("gpt2_moe", dtype=jnp.bfloat16,
+                               attention_impl="flash", seq_len=SEQ,
+                               moe_impl=moe_impl)
+    if f_chunk is not None:
+        model = model.clone(moe_f_chunk=f_chunk)
+    batch = SyntheticTokens(BATCH * layout.total_workers, SEQ,
+                            vocab_size=model.vocab_size,
+                            causal_lm=True).batch()
+    state = step_mod.make_train_state(model, cfg, batch)
+    state = step_mod.replicate_state(state, mesh)
+    train_step = step_mod.build_train_step(mesh, cfg, spec)
+    dev_batch = step_mod.shard_batch(batch, mesh)
+    rng = jax.random.PRNGKey(1)
+
+    def segment(state, n):
+        metrics = None
+        for i in range(n):
+            state, metrics = train_step(state, dev_batch,
+                                        jax.random.fold_in(rng, i))
+        return state, metrics
+
+    return state, segment
+
+
+def main():
+    layout = discover_layout()
+    mesh = build_mesh(layout)
+    n_ex = BATCH * layout.total_workers
+
+    arms: dict[str, tuple] = {}
+
+    def warm(name, **kw):
+        t0 = time.perf_counter()
+        try:
+            state, seg = build_arm(**kw, mesh=mesh, layout=layout)
+            state, m = seg(state, 2)
+            loss = float(jax.device_get(m["loss"]))
+        except Exception as e:
+            print(f"{name}: FAILED {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:200]}", flush=True)
+            return False
+        print(f"{name}: compiled+warm {time.perf_counter()-t0:.1f}s "
+              f"loss={loss:.3f}", flush=True)
+        arms[name] = (state, seg)
+        return True
+
+    def timed(name):
+        state, seg = arms[name]
+        state, m0 = seg(state, 1)
+        jax.device_get(m0["loss"])
+        t0 = time.perf_counter()
+        state, m = seg(state, STEPS)
+        jax.device_get(m["loss"])
+        dt = time.perf_counter() - t0
+        arms[name] = (state, seg)
+        rate = STEPS * n_ex / dt
+        print(f"  {name:16s} {1e3*dt/STEPS:8.2f} ms/step "
+              f"{rate:8.2f} ex/s", flush=True)
+        return rate
+
+    print(f"== gpt2_moe seq={SEQ} bs={BATCH} bf16 flash ==", flush=True)
+    # phase 1: f-chunk sweep, ONE arm alive at a time (a 16G chip cannot
+    # hold four gpt2_moe states + momentum simultaneously)
+    sweep: dict[str, float] = {}
+    for name, kw in (
+            ("ragged_f512", dict(moe_impl="ragged", f_chunk=512)),
+            ("ragged_f1024", dict(moe_impl="ragged", f_chunk=1024)),
+            ("ragged_f2048", dict(moe_impl="ragged", f_chunk=2048)),
+            ("ragged_full", dict(moe_impl="ragged", f_chunk=0))):
+        if warm(name, **kw):
+            sweep[name] = timed(name)
+        arms.pop(name, None)          # free the state before the next arm
+
+    ragged_variants = {n: r for n, r in sweep.items() if n != "ragged_full"}
+    if not ragged_variants:
+        print("no tiled ragged variant ran; nothing to contest")
+        return
+    best = max(ragged_variants, key=ragged_variants.get)
+    print(f"best tiled variant: {best} ({ragged_variants[best]:.2f} ex/s)",
+          flush=True)
+
+    # phase 2: drift-paired crossover — einsum control brackets each
+    # ragged segment; only these two arms alive
+    if not warm("einsum", moe_impl="einsum"):
+        return
+    warm(best, moe_impl="ragged",
+         f_chunk=int(best.split("_f")[1]))
+    controls, variants = [], []
+    controls.append(timed("einsum"))
+    for _ in range(REPS):
+        variants.append(timed(best))
+        controls.append(timed("einsum"))
+    ratios = [v / ((controls[i] + controls[i + 1]) / 2)
+              for i, v in enumerate(variants)]
+    print(f"controls (einsum): {[f'{c:.2f}' for c in controls]}")
+    print(f"variants ({best}): {[f'{v:.2f}' for v in variants]}")
+    print(f"ratios: {[f'{r:.3f}' for r in ratios]}")
+    print(f"MEDIAN {best}/einsum: {statistics.median(ratios):.3f}x")
+
+
+if __name__ == "__main__":
+    main()

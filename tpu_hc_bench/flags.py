@@ -180,6 +180,15 @@ class BenchmarkConfig:
                                               # ceil(cf*k*S/E): the
                                               # token-drop pressure valve
                                               # for long-context MoE
+    moe_f_chunk: int = 0                      # ragged MoE: FFN-dim tile of
+                                              # the grouped matmuls (0 =
+                                              # full width, measured best;
+                                              # BASELINE.md MoE round 4)
+    scan_layers: bool = False                 # decoders: lax.scan over
+                                              # stacked layers (one
+                                              # compiled body; the
+                                              # program-size lever for
+                                              # deep/HLO-heavy stacks)
     rnn_impl: str = "hoisted"                 # hoisted|flax: RNN members'
                                               # GRU form (hoisted = input
                                               # projections batched out of
@@ -471,6 +480,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "einsum", "ragged"])
     p.add_argument("--rnn_impl", type=str, default=d.rnn_impl,
                    choices=["hoisted", "flax"])
+    p.add_argument("--scan_layers", type=_parse_bool, default=d.scan_layers)
+    p.add_argument("--moe_f_chunk", type=int, default=d.moe_f_chunk)
     return p
 
 

@@ -207,7 +207,8 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
                  gradient_checkpointing: bool = False,
                  moe_impl: str = "einsum", seq_axis: str | None = None,
                  moe_capacity_factor: float = 1.25,
-                 fused_conv: bool = False, rnn_impl: str = "hoisted"):
+                 fused_conv: bool = False, rnn_impl: str = "hoisted",
+                 scan_layers: bool = False, moe_f_chunk: int = 0):
     spec = get_model_spec(name)
     kwargs: dict[str, Any] = {"num_classes": num_classes, "dtype": dtype}
     if getattr(spec, "ctc", False):
@@ -219,6 +220,7 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
     if spec.moe:
         kwargs["moe_impl"] = moe_impl
         kwargs["moe_capacity_factor"] = moe_capacity_factor
+        kwargs["moe_f_chunk"] = moe_f_chunk
     elif moe_impl != "einsum":
         raise ValueError(f"--moe_impl only applies to MoE members, not {name}")
     elif moe_capacity_factor != 1.25:
@@ -230,6 +232,14 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
     if spec.attention or spec.is_text:  # transformers: kernel + remat knobs
         kwargs["attention_impl"] = attention_impl
         kwargs["remat"] = gradient_checkpointing
+    if scan_layers:
+        import inspect
+
+        if "scan_layers" not in inspect.signature(spec.create).parameters:
+            raise ValueError(
+                f"--scan_layers is not supported for {name} (GPT-family "
+                "decoders only)")
+        kwargs["scan_layers"] = True
     if spec.is_text:
         kwargs["seq_axis"] = seq_axis
         if seq_len is not None:

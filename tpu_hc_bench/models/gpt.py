@@ -49,6 +49,9 @@ class DecoderLayer(nn.Module):
     top_k: int = 2
     moe_impl: str = "einsum"
     moe_capacity_factor: float = 1.25
+    moe_f_chunk: int = 0               # ragged path: FFN-dim tile (0 =
+                                       # full width; measured FASTER at
+                                       # every reachable shape, round 4)
     causal: bool = True                # ViT reuses this block bidirectional
 
     @nn.compact
@@ -68,6 +71,7 @@ class DecoderLayer(nn.Module):
                        top_k=self.top_k, dtype=self.dtype,
                        impl=self.moe_impl,
                        capacity_factor=self.moe_capacity_factor,
+                       ragged_f_chunk=self.moe_f_chunk,
                        name="moe")(h)
         else:
             h = nn.Dense(self.ffn, dtype=self.dtype, name="fc")(h)
@@ -91,6 +95,24 @@ class GPTLM(nn.Module):
     top_k: int = 2
     moe_impl: str = "einsum"           # einsum (GSPMD/EP) | ragged (fast DP)
     moe_capacity_factor: float = 1.25  # einsum slots/expert multiplier
+    moe_f_chunk: int = 0               # ragged grouped-matmul FFN tile
+                                       # (0 = full width, the measured
+                                       # default; see BASELINE.md MoE)
+    scan_layers: bool = False          # lax.scan over stacked layers: ONE
+                                       # compiled layer body regardless of
+                                       # depth.  The program-size lever:
+                                       # unrolled deep stacks of HLO-heavy
+                                       # layers (ragged MoE's per-layer
+                                       # sort) can crash/bloat compilation
+                                       # (round 4: ragged bs=16 compiled
+                                       # at <=6 unrolled layers, died at
+                                       # >=9; scan compiles any depth).
+                                       # Param tree: layers/<...> stacked
+                                       # [L, ...] instead of layer_i/<...>
+                                       # -- NOT interchangeable with the
+                                       # unrolled checkpoints and not yet
+                                       # wired to TP/EP/PP sharding rules
+                                       # (driver guards those combos).
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True):
@@ -106,15 +128,25 @@ class GPTLM(nn.Module):
         # (self, x, train) -> train is static
         layer_cls = (nn.remat(DecoderLayer, static_argnums=(2,))
                      if self.remat else DecoderLayer)
-        for i in range(self.num_layers):
-            x = layer_cls(
-                self.hidden, self.heads, self.ffn, dtype=self.dtype,
-                attention_impl=self.attention_impl, seq_axis=self.seq_axis,
-                num_experts=self.num_experts, top_k=self.top_k,
-                moe_impl=self.moe_impl,
-                moe_capacity_factor=self.moe_capacity_factor,
-                name=f"layer_{i}",
-            )(x, train)
+        layer_kw = dict(
+            hidden=self.hidden, heads=self.heads, ffn=self.ffn,
+            dtype=self.dtype, attention_impl=self.attention_impl,
+            seq_axis=self.seq_axis, num_experts=self.num_experts,
+            top_k=self.top_k, moe_impl=self.moe_impl,
+            moe_capacity_factor=self.moe_capacity_factor,
+            moe_f_chunk=self.moe_f_chunk)
+        if self.scan_layers:
+            # scan-over-layers: stacked params [L, ...], one compiled
+            # body; dropout rngs split per layer, sown aux losses stack
+            scan = nn.scan(
+                lambda module, carry, _: (module(carry, train), None),
+                variable_axes={"params": 0, "losses": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=self.num_layers)
+            x, _ = scan(layer_cls(**layer_kw, name="layers"), x, None)
+        else:
+            for i in range(self.num_layers):
+                x = layer_cls(**layer_kw, name=f"layer_{i}")(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         # tied output projection: operands in compute dtype, f32
         # accumulation (the MXU-native mode; the 50k-vocab cross-entropy
@@ -141,7 +173,8 @@ class GPTLM(nn.Module):
             attention_impl=self.attention_impl,
             num_experts=self.num_experts, top_k=self.top_k,
             moe_impl=self.moe_impl,
-            moe_capacity_factor=self.moe_capacity_factor)
+            moe_capacity_factor=self.moe_capacity_factor,
+            moe_f_chunk=self.moe_f_chunk)
 
     @nn.nowrap
     def pp_embed(self, params: dict, token_ids, rng):
@@ -172,30 +205,33 @@ class GPTLM(nn.Module):
 
 def gpt2(num_classes: int = 0, dtype=jnp.float32,
          attention_impl: str = "dense", max_len: int | None = None,
-         remat: bool = False, seq_axis: str | None = None):
+         remat: bool = False, seq_axis: str | None = None,
+         scan_layers: bool = False):
     """GPT-2 small (124M); num_classes is ignored (vocab is the space)."""
     del num_classes
     return GPTLM(dtype=dtype, attention_impl=attention_impl,
                  max_len=max(GPT2_CTX, max_len or 0), remat=remat,
-                 seq_axis=seq_axis)
+                 seq_axis=seq_axis, scan_layers=scan_layers)
 
 
 def gpt2_medium(num_classes: int = 0, dtype=jnp.float32,
                 attention_impl: str = "dense", max_len: int | None = None,
-                remat: bool = False, seq_axis: str | None = None):
+                remat: bool = False, seq_axis: str | None = None,
+                scan_layers: bool = False):
     """GPT-2 medium (~355M: 24L/1024H/16 heads)."""
     del num_classes
     return GPTLM(hidden=1024, num_layers=24, heads=16, ffn=4096,
                  dtype=dtype, attention_impl=attention_impl,
                  max_len=max(GPT2_CTX, max_len or 0), remat=remat,
-                 seq_axis=seq_axis)
+                 seq_axis=seq_axis, scan_layers=scan_layers)
 
 
 def gpt2_moe(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
              remat: bool = False, moe_impl: str = "einsum",
              seq_axis: str | None = None,
-             moe_capacity_factor: float = 1.25):
+             moe_capacity_factor: float = 1.25,
+             scan_layers: bool = False, moe_f_chunk: int = 0):
     """GPT-2-small trunk with 8-expert top-2 MoE FFNs (~520M params,
     ~180M active per token: the 124M dense trunk swaps its 57M of FFNs
     for 2x-of-8 expert FFNs) — the expert-parallel workload."""
@@ -204,14 +240,16 @@ def gpt2_moe(num_classes: int = 0, dtype=jnp.float32,
                  max_len=max(GPT2_CTX, max_len or 0), remat=remat,
                  num_experts=8, top_k=2, moe_impl=moe_impl,
                  moe_capacity_factor=moe_capacity_factor,
-                 seq_axis=seq_axis)
+                 seq_axis=seq_axis, scan_layers=scan_layers,
+                 moe_f_chunk=moe_f_chunk)
 
 
 def moe_tiny(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
              remat: bool = False, moe_impl: str = "einsum",
              seq_axis: str | None = None,
-             moe_capacity_factor: float = 1.25):
+             moe_capacity_factor: float = 1.25,
+             scan_layers: bool = False, moe_f_chunk: int = 0):
     """4-layer/128-hidden 4-expert decoder for tests and CPU smoke runs."""
     del num_classes
     return GPTLM(vocab_size=1024, hidden=128, num_layers=4, heads=4,
@@ -219,4 +257,5 @@ def moe_tiny(num_classes: int = 0, dtype=jnp.float32,
                  max_len=max(128, max_len or 0), remat=remat,
                  num_experts=4, top_k=2, moe_impl=moe_impl,
                  moe_capacity_factor=moe_capacity_factor,
-                 seq_axis=seq_axis)
+                 seq_axis=seq_axis, scan_layers=scan_layers,
+                 moe_f_chunk=moe_f_chunk)

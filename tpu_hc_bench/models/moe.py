@@ -127,13 +127,15 @@ class MoEFFN(nn.Module):
                                        # run as a lax.map over chunks so
                                        # Mosaic's scoped-VMEM tiling never
                                        # sees an oversized operand
-    ragged_f_chunk: int = 1024         # ragged path: tile the FFN (F) dim
-                                       # of the [E,H,F]/[E,F,H] weights so
-                                       # each grouped matmul's weight block
-                                       # fits Mosaic's scoped VMEM (round-3
-                                       # failure: 19.4M > 16M on the full
-                                       # [8,3072,768] contraction at
-                                       # bs=16/seq=1024); 0 disables
+    ragged_f_chunk: int = 0            # ragged path: optionally tile the
+                                       # FFN (F) dim of the [E,H,F]/[E,F,H]
+                                       # weights (0 = full width).  Round 4
+                                       # measured full width FASTER at every
+                                       # reachable shape (the round-3 bs=16
+                                       # failure was a whole-program compile
+                                       # crash, not this kernel's VMEM —
+                                       # see BASELINE.md MoE); the knob
+                                       # stays for exploration
 
     @nn.compact
     def __call__(self, x):

@@ -390,6 +390,12 @@ def run_benchmark(
         raise ValueError(
             "--model_parallel and --expert_parallel share the mesh's "
             "model axis; pick one")
+    if getattr(cfg, "scan_layers", False) and (pp > 1 or tp > 1 or ep > 1):
+        raise ValueError(
+            "--scan_layers stacks the trunk params [L, ...] (one compiled "
+            "layer body), which the layer_i-based PP interface and the "
+            "per-tensor TP/EP sharding rules do not address yet; drop "
+            "--scan_layers or the model/pipe axes")
     if pp > 1 and sp > 1:
         raise ValueError(
             "--pipeline_parallel x --sequence_parallel is not a supported "
@@ -471,8 +477,10 @@ def run_benchmark(
                                gradient_checkpointing=cfg.gradient_checkpointing,
                                moe_impl=getattr(cfg, "moe_impl", "einsum"),
                                rnn_impl=getattr(cfg, "rnn_impl", "hoisted"),
+                               scan_layers=getattr(cfg, "scan_layers", False),
                                moe_capacity_factor=getattr(
                                    cfg, "moe_capacity_factor", 1.25),
+                               moe_f_chunk=getattr(cfg, "moe_f_chunk", 0),
                                seq_axis=SEQ_AXIS if sp_active else None)
     if sp_active:
         seq_len = spec.input_shape[0]
