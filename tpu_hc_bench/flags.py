@@ -117,6 +117,12 @@ class BenchmarkConfig:
     # tf_cnn_benchmarks' input-pipeline private threadpool — here it is the
     # REAL width of the host JPEG decode pool (data/imagenet.py); 0 = auto
     datasets_num_private_threads: int = 0
+    # tf_cnn_benchmarks --datasets_repeat_cached_sample: decode a small set
+    # of real batches ONCE, keep them device-resident, and cycle them every
+    # step.  Measures the DEVICE-side real-data step cost (uint8 wire cast +
+    # normalize inside the compiled step) with the host decode/transfer wall
+    # taken out — the flag tf_cnn ships for exactly this isolation.
+    datasets_repeat_cached_sample: bool = False
 
     # --- TPU-native additions (no reference analog) ---
     fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
@@ -389,8 +395,10 @@ class BenchmarkConfig:
             f"optimizer={self.optimizer} dtype={self.compute_dtype}",
             f"warmup={self.num_warmup_batches} timed={self.num_batches} "
             f"display_every={self.display_every} forward_only={self.forward_only}",
-            f"data={'synthetic' if self.data_dir is None else self.data_dir} "
-            f"({self.data_name}, {self.data_format})",
+            f"data={'synthetic' if self.data_dir is None else self.data_dir}"
+            + (" [repeat_cached_sample]"
+               if self.datasets_repeat_cached_sample else "")
+            + f" ({self.data_name}, {self.data_format})",
             f"variable_update={self.variable_update} "
             f"fusion_threshold={self.fusion_threshold_bytes}B"
             + (f" model_parallel={self.model_parallel}"
@@ -446,6 +454,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kmp_affinity", type=str, default=d.kmp_affinity)
     p.add_argument("--datasets_num_private_threads", type=int,
                    default=d.datasets_num_private_threads)
+    p.add_argument("--datasets_repeat_cached_sample", type=_parse_bool,
+                   default=d.datasets_repeat_cached_sample)
     p.add_argument("--train_dir", type=str, default=None)
     p.add_argument("--save_model_steps", type=int, default=d.save_model_steps)
     p.add_argument("--moe_capacity_factor", type=float,
