@@ -1,0 +1,197 @@
+"""Profile-backed ViT bs=64 local-optimum attribution (round 4, VERDICT #9).
+
+Round-2 recorded vit_b16 peaking at bs=64 (37.4% MFU) with bs=128 *lower*
+— a local optimum explained as "cache-friendly regime" without a trace.
+This harness captures real jax.profiler traces for both batch sizes and
+aggregates device-track op time per EXAMPLE, so the claim gets op-level
+attribution the way ResNet's roofline did (scripts/roofline_resnet.py):
+which fusions grow super-linearly from bs=64 -> bs=128, and is the growth
+MXU work or data movement?
+
+Usage: python scripts/exp_vit_trace.py [--model vit_b16] [--batches 64,128]
+Writes traces under /tmp/vit_trace_<model>_<bs>/ and prints, per batch
+size:
+  - measured step time + per-example time (tunnel-safe protocol)
+  - top device ops by total time, normalized per example
+  - the bs-to-bs per-example delta per op class (matmul/conv vs
+    elementwise/copy/reduce)
+
+Measurement caveats found while building this (recorded in BASELINE.md):
+the axon tunnel's profiler reports device event durations scaled by a
+constant ~0.31 vs wall for BOTH resnet50 and vit_b16 — absolute device
+times are uncalibrated on this box, so everything below is interpreted
+as RATIOS (op fractions within a trace; per-example ratios between batch
+sizes), where the unknown scale cancels.  Wall step times are also
+subject to multi-second transient tunnel stall windows; re-run if the
+measured step time is wildly off the recorded zoo table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import sys
+import time
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from tpu_hc_bench import flags
+from tpu_hc_bench.data.synthetic import SyntheticImages
+from tpu_hc_bench.models import create_model
+from tpu_hc_bench.train import step as step_mod
+from tpu_hc_bench.topology import build_mesh, discover_layout
+
+WARMUP, TIMED, TRACED = 8, 20, 3
+
+
+def run_once(model_name: str, batch: int, trace_dir: str):
+    cfg = flags.BenchmarkConfig(model=model_name, batch_size=batch).resolve()
+    layout = discover_layout()
+    mesh = build_mesh(layout)
+    model, spec = create_model(model_name, dtype=jnp.bfloat16)
+    raw = SyntheticImages(batch, spec.input_shape).batch()
+    state = step_mod.make_train_state(model, cfg, raw)
+    state = step_mod.replicate_state(state, mesh)
+    train_step = step_mod.build_train_step(mesh, cfg, spec)
+    dev_batch = step_mod.shard_batch(raw, mesh)
+    rng = jax.random.PRNGKey(0)
+    for _ in range(WARMUP):
+        state, metrics = train_step(state, dev_batch, rng)
+    jax.device_get(metrics["loss"])  # tunnel-safe sync
+    t0 = time.perf_counter()
+    for _ in range(TIMED):
+        state, metrics = train_step(state, dev_batch, rng)
+    jax.device_get(metrics["loss"])
+    step_ms = (time.perf_counter() - t0) / TIMED * 1e3
+    # traced steps are separate so profiler overhead never taints timing
+    with jax.profiler.trace(trace_dir):
+        for _ in range(TRACED):
+            state, metrics = train_step(state, dev_batch, rng)
+        jax.device_get(metrics["loss"])
+    return step_ms
+
+
+def device_op_times(trace_dir: str) -> tuple[dict[str, float],
+                                             dict[str, int]]:
+    """Aggregate device-track op durations (us) + event counts from the
+    perfetto trace.  Counts are raw event counts (all traced steps, all
+    device pids); divide by TRACED for per-step instruction counts —
+    single-chip vit traces show exactly TRACED events per name."""
+    paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    device_pids = {
+        e["pid"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "TPU" in str(e.get("args", {}).get("name", ""))
+    }
+    if not device_pids:
+        # fail as loudly as the missing-trace case: an attribution table
+        # silently built from zero device events reads as "no hot ops"
+        raise RuntimeError(
+            f"trace under {trace_dir} has no TPU device track — "
+            "did the run fall back to CPU?")
+    ops: dict[str, float] = defaultdict(float)
+    counts: dict[str, int] = defaultdict(int)
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        name = e["name"]
+        # step-level envelope events (the whole jitted step, and its
+        # per-step children named "0","1","2",...) nest every op — keeping
+        # them would triple-count; attribution wants leaf ops only
+        if name.isdigit() or name.startswith("jit_"):
+            continue
+        ops[name] += e.get("dur", 0)
+        counts[name] += 1
+    return dict(ops), dict(counts)
+
+
+def classify(name: str) -> str:
+    n = name.lower()
+    # order matters — later checks use substrings the earlier classes
+    # also contain:
+    #   collectives first ("all-reduce" would otherwise hit "reduce");
+    #   reductions before conv ("convert_reduce_fusion" contains "conv"
+    #   but its work is the reduction, the cast is fused in);
+    #   casts/relayouts before conv ("bitcast_convert"/"convert" contain
+    #   "conv" but move/cast bytes, no MXU work)
+    if any(k in n for k in ("all-reduce", "allreduce", "all-gather",
+                            "allgather", "reduce-scatter", "all-to-all",
+                            "collective", "permute", "psum")):
+        return "collective"
+    if any(k in n for k in ("reduce", "norm", "softmax")):
+        return "reduce/norm"
+    if any(k in n for k in ("copy", "transpose", "reshape", "bitcast",
+                            "convert", "concatenate", "slice", "pad")):
+        return "data-movement"
+    if "conv" in n:
+        return "conv"
+    if "dot" in n or "matmul" in n or "einsum" in n:
+        return "matmul"
+    if any(k in n for k in ("infeed", "outfeed", "barrier", "sync")):
+        return "infra"
+    return "elementwise/other"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="vit_b16")
+    ap.add_argument("--batches", default="64,128")
+    ap.add_argument("--top", type=int, default=18)
+    args = ap.parse_args(argv)
+    batches = [int(b) for b in args.batches.split(",")]
+
+    results = {}
+    for bs in batches:
+        tdir = f"/tmp/vit_trace_{args.model}_{bs}"
+        step_ms = run_once(args.model, bs, tdir)
+        ops, counts = device_op_times(tdir)
+        results[bs] = (step_ms, ops, counts)
+        print(f"\n=== {args.model} bs={bs}: {step_ms:.2f} ms/step, "
+              f"{step_ms / bs * 1e3:.1f} us/example ===")
+        total = sum(ops.values())
+        for name, us in sorted(ops.items(), key=lambda kv: -kv[1])[:args.top]:
+            print(f"  {us / TRACED / bs:9.2f} us/ex  {us / total:5.1%}  "
+                  f"[{classify(name):>17s}]  {name[:90]}")
+
+    def by_class(bs):
+        _, ops, counts = results[bs]
+        us = defaultdict(float)
+        count = defaultdict(float)
+        for n, u in ops.items():
+            c = classify(n)
+            us[c] += u / TRACED / bs
+            # per-step executions, measured (not assumed once-per-name):
+            # raw event count over TRACED steps / TRACED
+            count[c] += counts[n] / TRACED
+        return us, count
+
+    # compare adjacent batch-size pairs (the common case is exactly two)
+    for a, b in zip(batches, batches[1:]):
+        cls_a, cnt_a = by_class(a)
+        cls_b, cnt_b = by_class(b)
+        print(f"\n=== per-example us by op class: bs={a} vs bs={b} "
+              f"(count = ops/step) ===")
+        print(f"{'class':>18s} {('bs=%d' % a):>10s} {'#':>6s}"
+              f" {('bs=%d' % b):>10s} {'#':>6s} {'ratio':>7s}")
+        for c in sorted(set(cls_a) | set(cls_b),
+                        key=lambda c: -cls_b.get(c, 0)):
+            ra, rb = cls_a.get(c, 0.0), cls_b.get(c, 0.0)
+            ratio = rb / ra if ra else float("inf")
+            print(f"{c:>18s} {ra:10.2f} {cnt_a.get(c, 0):6.0f}"
+                  f" {rb:10.2f} {cnt_b.get(c, 0):6.0f} {ratio:7.2f}x")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
