@@ -147,6 +147,12 @@ class BenchmarkConfig:
     wire_dtype: str = "uint8"                 # real-data host->device wire
                                               # format; uint8 = 4x less
                                               # traffic, normalize on device
+    gradient_accumulation_steps: int = 1      # split each step's batch into
+                                              # N microbatches (lax.scan),
+                                              # average grads, ONE allreduce
+                                              # + optimizer update — batch
+                                              # scaling without remat's
+                                              # recompute or PP's pipeline
     model_parallel: int = 1                   # tensor-parallel degree over
                                               # the mesh "model" axis
                                               # (Megatron-style GSPMD
@@ -260,6 +266,35 @@ class BenchmarkConfig:
                 "--model_parallel and --expert_parallel are exclusive: both "
                 "shard over the mesh 'model' axis"
             )
+        if self.gradient_accumulation_steps < 1:
+            raise ValueError(
+                f"--gradient_accumulation_steps must be >= 1: "
+                f"{self.gradient_accumulation_steps}")
+        if self.gradient_accumulation_steps > 1:
+            # accumulation lives in the explicit-psum DP/SP step (a
+            # lax.scan over microbatches before the single fused
+            # allreduce); the other arms reject loudly rather than run
+            # with the flag silently ignored
+            if self.pipeline_parallel > 1:
+                raise ValueError(
+                    "--gradient_accumulation_steps: pipeline parallelism "
+                    "already microbatches (--num_microbatches)")
+            if self.model_parallel > 1 or self.expert_parallel > 1:
+                raise ValueError(
+                    "--gradient_accumulation_steps is not supported on the "
+                    "GSPMD TP/EP arm (supported: DP and DP x SP)")
+            if self.variable_update == "replicated" and (
+                    self.sequence_parallel <= 1):
+                # under SP, replicated is translated to psum further down
+                # (the SP block below) — that combo is supported; only the
+                # true GSPMD arm rejects
+                raise ValueError(
+                    "--gradient_accumulation_steps needs "
+                    "--variable_update=psum (the explicit-psum step)")
+            if self.forward_only or self.eval:
+                raise ValueError(
+                    "--gradient_accumulation_steps is a training-step "
+                    "knob; it has no meaning forward-only / under --eval")
         # round 2: minor axes compose — supported hybrids are DPxPPxTP and
         # DPxSPxTP (model auto/GSPMD under a manual PP/SP shard_map); the
         # remaining pairings are rejected here and in run_benchmark
@@ -409,7 +444,10 @@ class BenchmarkConfig:
                f" num_microbatches={self.num_microbatches or 'auto'}"
                if self.pipeline_parallel > 1 else "")
             + (f" sequence_parallel={self.sequence_parallel}"
-               if self.sequence_parallel > 1 else ""),
+               if self.sequence_parallel > 1 else "")
+            + (f" gradient_accumulation_steps="
+               f"{self.gradient_accumulation_steps}"
+               if self.gradient_accumulation_steps > 1 else ""),
         ]
         for k, v in self.translations.items():
             lines.append(f"translated: {k}: {v}")
@@ -473,6 +511,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq_len", type=int, default=d.seq_len)
     p.add_argument("--wire_dtype", type=str, default=d.wire_dtype,
                    choices=["float32", "uint8"])
+    p.add_argument("--gradient_accumulation_steps", type=int,
+                   default=d.gradient_accumulation_steps)
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
     p.add_argument("--expert_parallel", type=int, default=d.expert_parallel)
     p.add_argument("--pipeline_parallel", type=int,

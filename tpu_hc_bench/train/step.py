@@ -224,6 +224,13 @@ def build_train_step(
     if dcn and fab is fabric_mod.Fabric.HOST:
         raise ValueError("fabric=host has no multislice layout")
 
+    if getattr(cfg, "gradient_accumulation_steps", 1) > 1 and (
+            fab is fabric_mod.Fabric.HOST):
+        # flags.resolve() rejects the other unsupported arms; the fabric
+        # is only known here
+        raise ValueError(
+            "--gradient_accumulation_steps is not supported on the host "
+            "(sock-analog) fabric step")
     if fab is fabric_mod.Fabric.HOST:
         return _build_host_step(mesh, cfg, is_text, ctc=ctc)
     if not sp and (tp or getattr(cfg, "expert_parallel", 1) > 1):
@@ -255,6 +262,63 @@ def build_train_step(
         # reduce per-tensor instead
         fuse = False
 
+    accum = getattr(cfg, "gradient_accumulation_steps", 1)
+
+    def _accumulated_grads(state, batch, dropout_rng):
+        """lax.scan over ``accum`` microbatches: per-microbatch forward +
+        backward with microbatch-sized activations (the memory win remat
+        buys by recompute, bought here by splitting), grads/loss/stats
+        averaged in fp-accumulator trees, ONE allreduce afterwards.
+
+        Microbatch semantics (standard accumulation): each microbatch's
+        loss is mean-normalized over its own examples/weights, then the
+        N means are averaged — identical to the full batch for uniform
+        weights, the usual approximation otherwise.
+
+        BN running stats: each microbatch EMA-updates from the SAME
+        starting stats and the results are averaged, i.e. the running
+        statistics advance by ONE decay step per optimizer step (toward
+        the mean of the microbatch statistics) — NOT the N chained
+        decays a sequential-microbatch implementation (e.g. torch-style
+        accumulation loops) would apply.  Train-mode forwards are
+        unaffected (BN normalizes with per-microbatch batch stats
+        either way); only the eval-time running-stat warm-up rate
+        differs, and one decay per optimizer step is the consistent
+        choice here.
+        """
+        local = jax.tree.leaves(batch)[0].shape[0]
+        if local % accum:
+            raise ValueError(
+                f"per-device batch {local} is not divisible by "
+                f"--gradient_accumulation_steps={accum}")
+        micro = jax.tree.map(
+            lambda x: x.reshape((accum, local // accum) + x.shape[1:]),
+            batch)
+        rngs = jax.random.split(dropout_rng, accum)
+
+        def body(carry, xs):
+            g_acc, l_acc, s_acc = carry
+            mb, rng_i = xs
+
+            def loss_fn(p):
+                return _loss_and_updates(state, p, mb, rng_i, is_text,
+                                         cfg.fused_xent, ctc)
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            g_acc = jax.tree.map(jnp.add, g_acc, grads)
+            s_acc = jax.tree.map(jnp.add, s_acc, stats)
+            return (g_acc, l_acc + loss, s_acc), None
+
+        init = (
+            jax.tree.map(jnp.zeros_like, state.params),
+            jnp.zeros((), jnp.float32),
+            jax.tree.map(jnp.zeros_like, state.batch_stats),
+        )
+        (g, l, s), _ = jax.lax.scan(body, init, (micro, rngs))
+        mean = lambda tree: jax.tree.map(lambda x: x / accum, tree)
+        return l / accum, mean(s), mean(g)
+
     def device_step(state: TrainState, batch, dropout_rng):
         # per-device: local shard of the batch, replicated state
         for a in axes:
@@ -262,13 +326,17 @@ def build_train_step(
                 dropout_rng, jax.lax.axis_index(a)
             )
 
-        def loss_fn(p):
-            return _loss_and_updates(state, p, batch, dropout_rng, is_text,
-                                      cfg.fused_xent, ctc)
+        if accum > 1:
+            loss, new_stats, grads = _accumulated_grads(
+                state, batch, dropout_rng)
+        else:
+            def loss_fn(p):
+                return _loss_and_updates(state, p, batch, dropout_rng,
+                                         is_text, cfg.fused_xent, ctc)
 
-        (loss, new_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
         grads = allreduce_gradients(
             grads,
             axis_name=axes,
