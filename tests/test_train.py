@@ -180,6 +180,12 @@ def test_grad_accumulation_driver_and_rejections(mesh8):
     cfg_sp = tiny_cfg(gradient_accumulation_steps=2, sequence_parallel=2,
                       variable_update="replicated")
     assert cfg_sp.variable_update == "psum"
+    # ...and the degenerate seq-1 axis (ring attention at SP=1), which
+    # translates replicated->psum through the other SP block
+    cfg_deg = tiny_cfg(model="bert_tiny", gradient_accumulation_steps=2,
+                       attention_impl="ring",
+                       variable_update="replicated")
+    assert cfg_deg.variable_update == "psum"
 
 
 def test_forward_only(mesh8):

@@ -283,11 +283,15 @@ class BenchmarkConfig:
                 raise ValueError(
                     "--gradient_accumulation_steps is not supported on the "
                     "GSPMD TP/EP arm (supported: DP and DP x SP)")
-            if self.variable_update == "replicated" and (
-                    self.sequence_parallel <= 1):
-                # under SP, replicated is translated to psum further down
-                # (the SP block below) — that combo is supported; only the
-                # true GSPMD arm rejects
+            if (self.variable_update == "replicated"
+                    and self.sequence_parallel <= 1
+                    and self.attention_impl not in
+                    ("ring", "ulysses", "ulysses_flash")):
+                # under SP — including the degenerate seq-1 axis the
+                # seq-sharded attention impls select — replicated is
+                # translated to psum further down (the SP blocks below),
+                # and that combo is supported; only the true GSPMD arm
+                # rejects
                 raise ValueError(
                     "--gradient_accumulation_steps needs "
                     "--variable_update=psum (the explicit-psum step)")

@@ -224,8 +224,8 @@ def build_train_step(
     if dcn and fab is fabric_mod.Fabric.HOST:
         raise ValueError("fabric=host has no multislice layout")
 
-    if getattr(cfg, "gradient_accumulation_steps", 1) > 1 and (
-            fab is fabric_mod.Fabric.HOST):
+    accum = getattr(cfg, "gradient_accumulation_steps", 1)
+    if accum > 1 and fab is fabric_mod.Fabric.HOST:
         # flags.resolve() rejects the other unsupported arms; the fabric
         # is only known here
         raise ValueError(
@@ -261,8 +261,6 @@ def build_train_step(
         # all-gathers of the model-sharded grads under the auto axis —
         # reduce per-tensor instead
         fuse = False
-
-    accum = getattr(cfg, "gradient_accumulation_steps", 1)
 
     def _accumulated_grads(state, batch, dropout_rng):
         """lax.scan over ``accum`` microbatches: per-microbatch forward +
