@@ -17,6 +17,10 @@ speedup is the MEDIAN of those ratios.  Sync is a value fetch
 (jax.device_get), never block_until_ready, per the tunnel rules.
 
 Usage: python scripts/exp_ds2_hoist.py [batch] [steps_per_segment] [reps]
+           [control_impl] [variant_impl]
+Round 4 follow-up: the same harness A/Bs any rnn_impl pair — e.g.
+``... 16 60 3 hoisted bidi`` contests BiHoistedGRU (both directions in
+one scan) against the hoisted two-scan default.
 """
 
 from __future__ import annotations
@@ -38,6 +42,8 @@ from tpu_hc_bench.train import step as step_mod
 BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 16
 STEPS = int(sys.argv[2]) if len(sys.argv) > 2 else 60
 REPS = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+CONTROL = sys.argv[4] if len(sys.argv) > 4 else "flax"
+VARIANT = sys.argv[5] if len(sys.argv) > 5 else "hoisted"
 
 
 def build_arm(rnn_impl: str, mesh, cfg, batch):
@@ -69,7 +75,7 @@ def main():
                             max_label_for(frames), seed=0).batch()
 
     arms = {}
-    for impl in ("flax", "hoisted"):
+    for impl in (CONTROL, VARIANT):
         t0 = time.perf_counter()
         state, seg = build_arm(impl, mesh, cfg, batch)
         state, metrics = seg(state, 3)           # compile + warm
@@ -93,18 +99,19 @@ def main():
         return rate
 
     controls, variants = [], []
-    controls.append(timed("flax"))
+    controls.append(timed(CONTROL))
     for _ in range(REPS):
-        variants.append(timed("hoisted"))
-        controls.append(timed("flax"))
+        variants.append(timed(VARIANT))
+        controls.append(timed(CONTROL))
     ratios = [v / ((controls[i] + controls[i + 1]) / 2)
               for i, v in enumerate(variants)]
-    print(f"controls (flax): {[f'{c:.1f}' for c in controls]}")
-    print(f"variants (hoisted): {[f'{v:.1f}' for v in variants]}")
+    print(f"controls ({CONTROL}): {[f'{c:.1f}' for c in controls]}")
+    print(f"variants ({VARIANT}): {[f'{v:.1f}' for v in variants]}")
     print(f"ratios: {[f'{r:.3f}' for r in ratios]}")
-    print(f"MEDIAN hoisted/flax speedup: {statistics.median(ratios):.3f}x")
-    print(f"hoisted median rate: {statistics.median(variants):.1f} ex/s; "
-          f"flax median rate: {statistics.median(controls):.1f} ex/s")
+    print(f"MEDIAN {VARIANT}/{CONTROL} speedup: "
+          f"{statistics.median(ratios):.3f}x")
+    print(f"{VARIANT} median rate: {statistics.median(variants):.1f} ex/s; "
+          f"{CONTROL} median rate: {statistics.median(controls):.1f} ex/s")
 
 
 if __name__ == "__main__":

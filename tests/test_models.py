@@ -531,13 +531,39 @@ def test_hoisted_gru_matches_flax_gru():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_bidi_gru_matches_hoisted_pair():
+    """BiHoistedGRU (both directions in one scan) must reproduce the sum
+    of a forward + reverse HoistedGRU pair exactly when the params are
+    copied across."""
+    from tpu_hc_bench.models.deepspeech import BiHoistedGRU, HoistedGRU
+
+    b, t, i, h = 2, 9, 5, 8
+    x = jax.random.normal(jax.random.PRNGKey(5), (b, t, i))
+    fwd = HoistedGRU(h)
+    bwd = HoistedGRU(h, reverse=True)
+    pf = fwd.init(jax.random.PRNGKey(6), x)["params"]
+    pb = bwd.init(jax.random.PRNGKey(7), x)["params"]
+    want = fwd.apply({"params": pf}, x) + bwd.apply({"params": pb}, x)
+    stacked = {
+        "fwd_input_gates": pf["input_gates"],
+        "bwd_input_gates": pb["input_gates"],
+        "fwd_hidden_gates": pf["hidden_gates"],
+        "bwd_hidden_gates": pb["hidden_gates"],
+        "fwd_candidate_bias": pf["candidate_bias"],
+        "bwd_candidate_bias": pb["candidate_bias"],
+    }
+    got = BiHoistedGRU(h).apply({"params": stacked}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_deepspeech2_rnn_impl_arms():
-    """Both rnn_impl arms build and run; hoisted is the default and the
+    """All rnn_impl arms build and run; hoisted is the default and the
     flax arm stays as the A/B control."""
     from tpu_hc_bench.models import create_model
 
     x = jnp.zeros((2, 64, 32), jnp.float32)
-    for impl in ("hoisted", "flax"):
+    for impl in ("hoisted", "bidi", "flax"):
         model, _ = create_model("deepspeech2_tiny")
         model = model.clone(rnn_impl=impl)
         v = model.init(jax.random.PRNGKey(0), x, train=False)

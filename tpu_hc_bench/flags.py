@@ -48,6 +48,11 @@ DEFAULT_DISPLAY_EVERY = 10  # --display_every=10 (:71)
 # combine threshold; see tpu_hc_bench.parallel.fabric.
 DEFAULT_FUSION_THRESHOLD_BYTES = 134217728
 
+# attention impls that shard (or degenerately carry) a sequence axis —
+# selecting one at --sequence_parallel=1 routes through the degenerate-SP
+# block in resolve(), which translates variable_update replicated->psum
+SEQ_SHARDED_IMPLS = ("ring", "ulysses", "ulysses_flash")
+
 
 def _parse_bool(v: str | bool) -> bool:
     """tf_cnn_benchmarks accepts TRUE/False/true/... for boolean flags."""
@@ -201,11 +206,13 @@ class BenchmarkConfig:
                                               # compiled body; the
                                               # program-size lever for
                                               # deep/HLO-heavy stacks)
-    rnn_impl: str = "hoisted"                 # hoisted|flax: RNN members'
-                                              # GRU form (hoisted = input
-                                              # projections batched out of
-                                              # the scan; flax = linen.RNN
-                                              # A/B control)
+    rnn_impl: str = "hoisted"                 # hoisted|bidi|flax: RNN
+                                              # members' GRU form (hoisted =
+                                              # input projections batched
+                                              # out of the scan; bidi = both
+                                              # BiGRU directions in one scan,
+                                              # a recorded-null A/B arm;
+                                              # flax = linen.RNN control)
     train_dir: str | None = None              # tf_cnn_benchmarks --train_dir:
                                               # save checkpoints here during
                                               # training; --eval restores the
@@ -285,8 +292,7 @@ class BenchmarkConfig:
                     "GSPMD TP/EP arm (supported: DP and DP x SP)")
             if (self.variable_update == "replicated"
                     and self.sequence_parallel <= 1
-                    and self.attention_impl not in
-                    ("ring", "ulysses", "ulysses_flash")):
+                    and self.attention_impl not in SEQ_SHARDED_IMPLS):
                 # under SP — including the degenerate seq-1 axis the
                 # seq-sharded attention impls select — replicated is
                 # translated to psum further down (the SP blocks below),
@@ -332,7 +338,7 @@ class BenchmarkConfig:
                     f"{self.sequence_parallel} shards the sequence axis)"
                 )
                 self.attention_impl = new
-        elif self.attention_impl in ("ring", "ulysses", "ulysses_flash"):
+        elif self.attention_impl in SEQ_SHARDED_IMPLS:
             # DEGENERATE SP (round 3): the seq-sharded impls run on a
             # size-1 seq axis — world-1 collectives are no-ops, so this
             # measures the SP machinery's overhead on a single chip (the
@@ -533,7 +539,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_impl", type=str, default=d.moe_impl,
                    choices=["auto", "einsum", "ragged"])
     p.add_argument("--rnn_impl", type=str, default=d.rnn_impl,
-                   choices=["hoisted", "flax"])
+                   choices=["hoisted", "bidi", "flax"])
     p.add_argument("--scan_layers", type=_parse_bool, default=d.scan_layers)
     p.add_argument("--moe_f_chunk", type=int, default=d.moe_f_chunk)
     return p

@@ -104,6 +104,68 @@ class HoistedGRU(nn.Module):
         return ys.transpose(1, 0, 2)        # [B, T, H]
 
 
+class BiHoistedGRU(nn.Module):
+    """Both directions of a sum-merged BiGRU in ONE ``lax.scan``.
+
+    ``HoistedGRU`` pairs run as two separate T-step scans per layer, and
+    XLA executes loops sequentially — so a 5-layer BiGRU serializes
+    10·T latency-bound [B, H]x[H, 3H] matmuls.  The two directions are
+    data-independent: at scan index j the forward direction processes
+    frame j while the backward direction processes frame T-1-j.  This
+    module stacks them into one scan — carry [2, B, H], hidden matmul
+    ``einsum('dbh,dhk->dbk')`` over stacked [2, H, 3H] kernels — halving
+    the sequential scan count (5·T steps of a double-batch matmul).
+    Same math as a (HoistedGRU fwd + HoistedGRU reverse) sum, pinned by
+    a param-copy parity test (tests/test_models.py).
+
+    Param layout intentionally mirrors the HoistedGRU pair:
+    ``{fwd,bwd}_input_gates`` Dense + ``{fwd,bwd}_hidden_gates`` /
+    ``{fwd,bwd}_candidate_bias``, gate order [r | z | n].
+    """
+
+    hidden: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, i = x.shape
+        h = self.hidden
+        dense = lambda name: nn.Dense(3 * h, use_bias=True,
+                                      dtype=self.dtype, name=name)
+        xg_f = dense("fwd_input_gates")(x)          # [B, T, 3H]
+        xg_b = dense("bwd_input_gates")(x)
+        wkern = lambda name: self.param(
+            name, nn.initializers.orthogonal(column_axis=-1),
+            (h, 3 * h), jnp.float32).astype(self.dtype)
+        bkern = lambda name: self.param(
+            name, nn.initializers.zeros_init(), (h,),
+            jnp.float32).astype(self.dtype)
+        wh = jnp.stack([wkern("fwd_hidden_gates"),
+                        wkern("bwd_hidden_gates")])       # [2, H, 3H]
+        bn = jnp.stack([bkern("fwd_candidate_bias"),
+                        bkern("bwd_candidate_bias")])     # [2, H]
+        # scan inputs [T, 2, B, 3H]: fwd in frame order, bwd reversed so
+        # scan index j carries its frame T-1-j
+        xs = jnp.stack([xg_f.transpose(1, 0, 2),
+                        xg_b[:, ::-1].transpose(1, 0, 2)], axis=1)
+
+        def step(carry, xg_t):                      # carry [2, B, H]
+            hg = jnp.einsum("dbh,dhk->dbk", carry, wh)
+            xr, xz, xn = jnp.split(xg_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = nn.sigmoid(xr + hr)
+            z = nn.sigmoid(xz + hz)
+            n = nn.tanh(xn + r * (hn + bn[:, None, :]))
+            new_h = (1.0 - z) * n + z * carry
+            return new_h, new_h
+
+        h0 = jnp.zeros((2, b, h), self.dtype)
+        _, ys = jax.lax.scan(step, h0, xs)          # [T, 2, B, H]
+        # fwd outputs are in frame order; bwd outputs come out in scan
+        # order (frame T-1-j) and reverse back; DS2 sum-merge
+        return (ys[:, 0] + ys[::-1, 1]).transpose(1, 0, 2)
+
+
 class DeepSpeech2(nn.Module):
     vocab_size: int = DS2_VOCAB
     rnn_hidden: int = 800
@@ -111,9 +173,11 @@ class DeepSpeech2(nn.Module):
     conv_channels: int = 32
     dtype: Any = jnp.float32
     rnn_impl: str = "hoisted"   # hoisted (input projections batched out
-                                # of the scan) | flax (linen.RNN/GRUCell,
-                                # all gates inside the recurrence) — the
-                                # round-4 A/B pair
+                                # of the scan, the default) | bidi (both
+                                # directions in one scan — measured
+                                # 0.916x, kept as a recorded-null A/B
+                                # arm) | flax (linen.RNN/GRUCell, all
+                                # gates inside the recurrence)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -137,6 +201,9 @@ class DeepSpeech2(nn.Module):
                                 name=f"gru{i}_fwd")(x)
                      + HoistedGRU(self.rnn_hidden, dtype=self.dtype,
                                   reverse=True, name=f"gru{i}_bwd")(x))
+            elif self.rnn_impl == "bidi":
+                y = BiHoistedGRU(self.rnn_hidden, dtype=self.dtype,
+                                 name=f"bigru{i}")(x)
             elif self.rnn_impl == "flax":
                 cell = lambda n: nn.RNN(nn.GRUCell(self.rnn_hidden,
                                                    dtype=self.dtype),
