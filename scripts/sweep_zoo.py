@@ -54,8 +54,11 @@ DEFAULT_MATRIX = [
     ("gpt2_moe", 16),
     ("llama_1b", 2),
     # zoo completed round 3 (tf_cnn's last two members)
-    ("ncf", 65536),
-    ("deepspeech2", 16),
+    # round 4: both members' old tf_cnn-default batches starved the chip
+    # (ds2 bs=16 ran the recurrence at M=16; see BASELINE.md "the plain
+    # batch-size levers") — these are the measured TPU operating points
+    ("ncf", 1048576),
+    ("deepspeech2", 256),
 ]
 
 # per-model extra flags (best-known single-chip configs, BASELINE.md)
