@@ -158,6 +158,15 @@ class BenchmarkConfig:
                                               # + optimizer update — batch
                                               # scaling without remat's
                                               # recompute or PP's pipeline
+    accum_dtype: str = "f32"                  # microbatch grad-accumulator
+                                              # dtype: f32 (exact mean) |
+                                              # bf16 (halves the accumulator
+                                              # tree AND the allreduce
+                                              # bytes — the HBM lever for
+                                              # param-bound members whose
+                                              # f32 tree OOMs: llama_1b,
+                                              # gpt2_moe; ~3 significant
+                                              # digits per grad)
     model_parallel: int = 1                   # tensor-parallel degree over
                                               # the mesh "model" axis
                                               # (Megatron-style GSPMD
@@ -305,6 +314,14 @@ class BenchmarkConfig:
                 raise ValueError(
                     "--gradient_accumulation_steps is a training-step "
                     "knob; it has no meaning forward-only / under --eval")
+        if self.accum_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"--accum_dtype must be f32 or bf16: {self.accum_dtype!r}")
+        if self.accum_dtype != "f32" and self.gradient_accumulation_steps == 1:
+            raise ValueError(
+                "--accum_dtype selects the microbatch grad-accumulator "
+                "dtype; it has no meaning without "
+                "--gradient_accumulation_steps > 1")
         # round 2: minor axes compose — supported hybrids are DPxPPxTP and
         # DPxSPxTP (model auto/GSPMD under a manual PP/SP shard_map); the
         # remaining pairings are rejected here and in run_benchmark
@@ -457,7 +474,9 @@ class BenchmarkConfig:
                if self.sequence_parallel > 1 else "")
             + (f" gradient_accumulation_steps="
                f"{self.gradient_accumulation_steps}"
-               if self.gradient_accumulation_steps > 1 else ""),
+               if self.gradient_accumulation_steps > 1 else "")
+            + (f" accum_dtype={self.accum_dtype}"
+               if self.accum_dtype != "f32" else ""),
         ]
         for k, v in self.translations.items():
             lines.append(f"translated: {k}: {v}")
@@ -523,6 +542,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "uint8"])
     p.add_argument("--gradient_accumulation_steps", type=int,
                    default=d.gradient_accumulation_steps)
+    p.add_argument("--accum_dtype", type=str, default=d.accum_dtype,
+                   choices=["f32", "bf16"])
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
     p.add_argument("--expert_parallel", type=int, default=d.expert_parallel)
     p.add_argument("--pipeline_parallel", type=int,

@@ -237,8 +237,8 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.float32,
 
         if "scan_layers" not in inspect.signature(spec.create).parameters:
             raise ValueError(
-                f"--scan_layers is not supported for {name} (GPT-family "
-                "decoders only)")
+                f"--scan_layers is not supported for {name} (decoder "
+                "families only: gpt2*/moe*/llama*)")
         kwargs["scan_layers"] = True
     if spec.is_text:
         kwargs["seq_axis"] = seq_axis

@@ -144,6 +144,19 @@ class LlamaLM(nn.Module):
     attention_impl: str = "dense"
     seq_axis: str | None = None
     remat: bool = False
+    scan_layers: bool = False          # lax.scan over stacked layers: ONE
+                                       # compiled layer body regardless of
+                                       # depth — same program-size lever as
+                                       # GPTLM.scan_layers (round 5: built
+                                       # because llama_1b's UNROLLED 16-layer
+                                       # 1.1B program is what the remote
+                                       # compile helper 500s on; round-4
+                                       # bisect: <=6 unrolled layers compile,
+                                       # >=9 crash).  Param tree: layers/<..>
+                                       # stacked [L, ...] instead of
+                                       # layer_i/<..> — not interchangeable
+                                       # with unrolled checkpoints, guarded
+                                       # off TP/EP/PP by the driver.
 
     @nn.compact
     def __call__(self, token_ids, train: bool = True):
@@ -151,13 +164,24 @@ class LlamaLM(nn.Module):
                      name="tok_embed")(token_ids)
         block_cls = (nn.remat(LlamaBlock, static_argnums=(2,))
                      if self.remat else LlamaBlock)
-        for i in range(self.num_layers):
-            x = block_cls(
-                self.hidden, self.heads, self.num_kv_heads, self.ffn,
-                self.max_len, dtype=self.dtype,
-                attention_impl=self.attention_impl, seq_axis=self.seq_axis,
-                name=f"layer_{i}",
-            )(x, train)
+        block_kw = dict(
+            hidden=self.hidden, heads=self.heads,
+            num_kv_heads=self.num_kv_heads, ffn=self.ffn,
+            max_len=self.max_len, dtype=self.dtype,
+            attention_impl=self.attention_impl, seq_axis=self.seq_axis)
+        if self.scan_layers:
+            # scan-over-layers: stacked params [L, ...], one compiled body
+            # (no dropout in the family, but params rngs still split per
+            # layer so each stacked slice initializes independently)
+            scan = nn.scan(
+                lambda module, carry, _: (module(carry, train), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=self.num_layers)
+            x, _ = scan(block_cls(**block_kw, name="layers"), x, None)
+        else:
+            for i in range(self.num_layers):
+                x = block_cls(**block_kw, name=f"layer_{i}")(x, train)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
         head = self.param(
             "lm_head", nn.initializers.normal(0.02),
@@ -195,22 +219,24 @@ class LlamaLM(nn.Module):
 
 def llama_1b(num_classes: int = 0, dtype=jnp.float32,
              attention_impl: str = "dense", max_len: int | None = None,
-             remat: bool = False, seq_axis: str | None = None):
+             remat: bool = False, seq_axis: str | None = None,
+             scan_layers: bool = False):
     """Llama-3.2-1B-shaped decoder (16L/2048H, 32q/8kv heads, SwiGLU
     8192, 32k vocab here to keep the head sane on one chip; ~1.1B
     params)."""
     del num_classes
     return LlamaLM(dtype=dtype, attention_impl=attention_impl,
                    max_len=max(2048, max_len or 0), remat=remat,
-                   seq_axis=seq_axis)
+                   seq_axis=seq_axis, scan_layers=scan_layers)
 
 
 def llama_tiny(num_classes: int = 0, dtype=jnp.float32,
                attention_impl: str = "dense", max_len: int | None = None,
-               remat: bool = False, seq_axis: str | None = None):
+               remat: bool = False, seq_axis: str | None = None,
+               scan_layers: bool = False):
     """4-layer/128-hidden 8q/2kv variant for tests and CPU smoke runs."""
     del num_classes
     return LlamaLM(vocab_size=1024, hidden=128, num_layers=4, heads=8,
                    num_kv_heads=2, ffn=256, max_len=max(128, max_len or 0),
                    dtype=dtype, attention_impl=attention_impl, remat=remat,
-                   seq_axis=seq_axis)
+                   seq_axis=seq_axis, scan_layers=scan_layers)

@@ -262,11 +262,24 @@ def build_train_step(
         # reduce per-tensor instead
         fuse = False
 
+    acc_bf16 = getattr(cfg, "accum_dtype", "f32") == "bf16"
+
     def _accumulated_grads(state, batch, dropout_rng):
         """lax.scan over ``accum`` microbatches: per-microbatch forward +
         backward with microbatch-sized activations (the memory win remat
         buys by recompute, bought here by splitting), grads/loss/stats
-        averaged in fp-accumulator trees, ONE allreduce afterwards.
+        summed in explicit accumulator trees, ONE allreduce afterwards.
+
+        Accumulator dtype (``--accum_dtype``): ``f32`` (default) sums in
+        float32 regardless of the param/grad dtype and returns the mean
+        cast back to the grad dtype — exact for the zoo's f32 params.
+        ``bf16`` sums bfloat16-quantized microbatch grads and KEEPS the
+        tree bf16 through the allreduce and into the optimizer (optax
+        promotes against its f32 traces): the accumulator HBM footprint
+        AND the gradient wire bytes halve — the lever for param-bound
+        members whose +1x-params f32 tree OOMs (llama_1b, gpt2_moe) — at
+        ~3 significant digits of gradient precision.  Loss and BN stats
+        always accumulate in f32.
 
         Microbatch semantics (standard accumulation): each microbatch's
         loss is mean-normalized over its own examples/weights, then the
@@ -304,18 +317,37 @@ def build_train_step(
 
             (loss, stats), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
-            g_acc = jax.tree.map(jnp.add, g_acc, grads)
-            s_acc = jax.tree.map(jnp.add, s_acc, stats)
+            # cast-then-add keeps the bf16 arm's sum in bf16 (an f32 add
+            # followed by a downcast would materialize the f32 tree the
+            # arm exists to avoid); the f32 arm's cast is a promote
+            g_acc = jax.tree.map(
+                lambda a, g: a + g.astype(a.dtype), g_acc, grads)
+            s_acc = jax.tree.map(
+                lambda a, x: a + x.astype(a.dtype), s_acc, stats)
             return (g_acc, l_acc + loss, s_acc), None
 
+        f32_like = lambda x: jnp.zeros(
+            x.shape, jnp.promote_types(x.dtype, jnp.float32))
         init = (
-            jax.tree.map(jnp.zeros_like, state.params),
+            jax.tree.map(
+                (lambda x: jnp.zeros(x.shape, jnp.bfloat16))
+                if acc_bf16 else f32_like,
+                state.params),
             jnp.zeros((), jnp.float32),
-            jax.tree.map(jnp.zeros_like, state.batch_stats),
+            jax.tree.map(f32_like, state.batch_stats),
         )
         (g, l, s), _ = jax.lax.scan(body, init, (micro, rngs))
-        mean = lambda tree: jax.tree.map(lambda x: x / accum, tree)
-        return l / accum, mean(s), mean(g)
+        if acc_bf16:
+            # mean stays bf16 end-to-end (allreduce + optimizer see bf16)
+            grads = jax.tree.map(
+                lambda x: (x.astype(jnp.float32) / accum
+                           ).astype(jnp.bfloat16), g)
+        else:
+            grads = jax.tree.map(
+                lambda x, p: (x / accum).astype(p.dtype), g, state.params)
+        stats = jax.tree.map(
+            lambda x, o: (x / accum).astype(o.dtype), s, state.batch_stats)
+        return l / accum, stats, grads
 
     def device_step(state: TrainState, batch, dropout_rng):
         # per-device: local shard of the batch, replicated state
