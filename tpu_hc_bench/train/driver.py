@@ -591,12 +591,15 @@ def run_benchmark(
         batch = next(host_iter)
 
         if cfg.datasets_repeat_cached_sample:
-            # tf_cnn_benchmarks --datasets_repeat_cached_sample: decode a
-            # handful of REAL batches once, park them on device, cycle.
-            # This takes the host decode + tunnel transfer wall out of the
-            # loop so the number measures the device-side real-data step
-            # (uint8 wire cast + normalize run inside the compiled step —
+            # --datasets_repeat_cached_sample: decode a handful of REAL
+            # batches once, park them on device, cycle.  This takes the
+            # host decode + tunnel transfer wall out of the loop so the
+            # number measures the device-side real-data step (uint8 wire
+            # cast + normalize run inside the compiled step —
             # train/step.py::prep_inputs), augmentation baked in at decode.
+            # Stricter isolation than tf_cnn's mechanics (which repeat one
+            # cached record through the LIVE pipeline and still pay the
+            # per-step transfer) — see the deviation note in flags.py.
             # 8 distinct batches keep XLA from seeing a constant input
             # while staying far under HBM pressure at bench batch sizes.
             import itertools
