@@ -101,17 +101,40 @@ def main(argv=None) -> int:
     np.testing.assert_allclose(np.asarray(g_native), np.asarray(g_eq))
     print("parity: equality-mask bwd == select-and-scatter bwd (tie-free)")
 
+    from tpu_hc_bench.ops.pool_bwd import max_pool as maxpool_pallas
+
     # googlenet's two dominant pool-bwd shapes at bs=256, bf16
     for shape in ((256, 112, 112, 64), (256, 56, 56, 192)):
         x = jax.random.normal(key, shape, jnp.bfloat16)
         Ho = (shape[1] - 3) // 2 + 1
         dy = jnp.ones((shape[0], Ho, Ho, shape[3]), jnp.bfloat16)
-        # bracketed C V C on the same chip
+        pall = functools.partial(maxpool_pallas, window=(3, 3),
+                                 strides=(2, 2), padding="VALID")
+        # bracketed C V C V C on the same chip
         n1 = time_arm(maxpool_native, x, dy, args.iters)
         e1 = time_arm(maxpool_eq, x, dy, args.iters)
         n2 = time_arm(maxpool_native, x, dy, args.iters)
-        print(f"{shape}: native {n1:.2f}/{n2:.2f} ms  eq-mask {e1:.2f} ms  "
-              f"ratio {e1 / ((n1 + n2) / 2):.3f}x")
+        p1 = time_arm(pall, x, dy, args.iters)
+        n3 = time_arm(maxpool_native, x, dy, args.iters)
+        print(f"{shape}: native {n1:.2f}/{n2:.2f}/{n3:.2f} ms  "
+              f"eq-mask {e1:.2f} ms ({e1 / ((n1 + n2) / 2):.3f}x)  "
+              f"PALLAS {p1:.2f} ms ({p1 / ((n2 + n3) / 2):.3f}x)")
+    # the stride-1 SAME branch-pool shape (9 of googlenet's 14 pools) —
+    # SAME on both arms, matching what the model actually runs
+    for shape in ((256, 28, 28, 256),):
+        x = jax.random.normal(key, shape, jnp.bfloat16)
+        dy = jnp.ones(shape, jnp.bfloat16)
+        nat = functools.partial(
+            lax.reduce_window, init_value=-jnp.inf, computation=lax.max,
+            window_dimensions=(1, 3, 3, 1), window_strides=(1, 1, 1, 1),
+            padding="SAME")
+        pall = functools.partial(maxpool_pallas, window=(3, 3),
+                                 strides=(1, 1), padding="SAME")
+        n1 = time_arm(nat, x, dy, args.iters)
+        p1 = time_arm(pall, x, dy, args.iters)
+        n2 = time_arm(nat, x, dy, args.iters)
+        print(f"{shape} s1 SAME: native {n1:.2f}/{n2:.2f} ms  "
+              f"PALLAS {p1:.2f} ms ({p1 / ((n1 + n2) / 2):.3f}x)")
     return 0
 
 

@@ -10,8 +10,13 @@ shows a win.  The record (BASELINE.md):
   (bert/gpt2/llama); kept as an experimental knob.
 - ``fused_conv`` — whole-model parity (isolated-segment wins don't
   transfer); kept flag-gated as the recorded measurement apparatus.
+- ``pool_bwd`` — recorded NULL (round 5): 1.6-4.4x slower than XLA's
+  select-and-scatter on googlenet's pool shapes (the 9-tap VPU loop
+  loses to the hardware window scan); kept as parity-tested apparatus,
+  not wired into any model.
 """
 
 from tpu_hc_bench.ops.flash_attention import flash_attention  # noqa: F401
 from tpu_hc_bench.ops.fused_conv import fused_bn_relu_conv  # noqa: F401
+from tpu_hc_bench.ops.pool_bwd import max_pool as pallas_max_pool  # noqa: F401
 from tpu_hc_bench.ops.xent import softmax_xent, softmax_xent_reference  # noqa: F401

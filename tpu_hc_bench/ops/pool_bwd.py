@@ -1,0 +1,193 @@
+"""Pallas max-pool with a VMEM-resident backward kernel.
+
+Why this exists (round 5): the googlenet trace attribution put **22.1%**
+of device time in `select-and-scatter` — XLA's max-pool VJP — at ~4-5x
+its bandwidth roofline (BASELINE.md round-5 attribution), and the
+XLA-level equality-mask rewrite is a recorded 1.8-2.4x NULL because
+every window tap re-reads the input from HBM
+(`scripts/exp_pool_bwd_r05.py`).  The only formulation that can reach
+the roofline reads each array once: this kernel holds a full spatial
+tile in VMEM and computes every tap from registers —
+
+    dx[i] = sum over taps k of  (x[i] == y[(i-k)/s]) * dy[(i-k)/s]
+
+with the strided reads done as phase reshapes (Mosaic has no strided
+slice / interior pad / scatter-add — probed; edge-pad + phase-stack
+interleave is the supported vocabulary).  Gradient semantics on TIES
+differ from select-and-scatter: every tied element receives the full
+cotangent (torch/TPU-common behavior) where s&s routes it to the first
+max only.  For continuous inputs ties have measure zero (parity-pinned
+in tests/test_pool_bwd.py).
+
+**RECORDED NULL (round 5, measured — `scripts/exp_pool_bwd_r05.py`,
+bracketed on hardware):** this kernel is 3.6x / 2.0x / 1.95x SLOWER
+than XLA's select-and-scatter on googlenet's three pool-bwd shapes
+(stride-2 stem pools + the stride-1 SAME branch pool).
+The in-VMEM tap loop is VPU-compute-bound — 9 taps x (f32 compare +
+select + pad-accumulate) is ~27 full-array vector passes, where s&s
+does one hardware window scan.  Together with the XLA equality-mask
+null (1.6-2.7x slower, same script) this closes the "s&s runs ~4x
+above its traffic roofline" finding: the headroom is not reachable by
+re-expressing the computation — s&s is compute-bound on window scans,
+not bandwidth-wasteful.  The kernel stays as working, parity-tested
+measurement apparatus (the house convention for contested nulls —
+see ops/xent.py, ops/fused_conv.py); it is NOT wired into any model.
+
+Reference anchor: tf_cnn_benchmarks' pooling layers run through
+MKL-DNN's pool-backward primitive (SURVEY.md §2b #21 — the compute
+engine the reference swaps in for exactly these hot ops); this is the
+TPU-native counterpart.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Mosaic's stack accounting for this kernel measures ~12.4 bytes per
+# input element per window tap (89.55M for 112x112x64 at 9 taps); the
+# scoped limit is raised to 100M of v5e's 128M physical VMEM and tiles
+# are budgeted against it with some slack
+VMEM_LIMIT_BYTES = 100 * 1024 * 1024
+_STACK_BYTES_PER_ELEM_TAP = 12.4
+_BUDGET = VMEM_LIMIT_BYTES * 0.9
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _same_pad_low(in_dim: int, window: int, stride: int) -> tuple[int, int]:
+    out = -(-in_dim // stride)
+    total = max((out - 1) * stride + window - in_dim, 0)
+    return out, total // 2
+
+
+def _pool_dims(x_shape, window, strides, padding):
+    H, W = x_shape[1], x_shape[2]
+    (wh, ww), (sh, sw) = window, strides
+    if padding == "SAME":
+        Ho, plh = _same_pad_low(H, wh, sh)
+        Wo, plw = _same_pad_low(W, ww, sw)
+    else:  # VALID
+        Ho, plh = (H - wh) // sh + 1, 0
+        Wo, plw = (W - ww) // sw + 1, 0
+    return Ho, Wo, plh, plw
+
+
+def _bwd_kernel(x_ref, y_ref, dy_ref, dx_ref, *, window, strides,
+                pads, out_dims):
+    (wh, ww), (sh, sw) = window, strides
+    plh, plw = pads
+    Ho, Wo = out_dims
+    x = x_ref[0]
+    y = y_ref[0]
+    dy = dy_ref[0].astype(jnp.float32)
+    H, W, C = x.shape
+    # pad x so every tap's phase-read is in bounds; -inf never equals a
+    # window max (a window always overlaps real input under SAME/VALID)
+    HpP = Ho + (wh - 1) // sh          # phase-array rows
+    WpP = Wo + (ww - 1) // sw
+    ninf = jnp.asarray(-jnp.inf, x.dtype)
+    xp = lax.pad(x, ninf, ((plh, HpP * sh - plh - H, 0),
+                           (plw, WpP * sw - plw - W, 0), (0, 0, 0)))
+    # two sequential single-dim phase splits (Mosaic rejects the 5-D
+    # double split's layout; one split at a time matches its tiling)
+    acc = {(pi, pj): jnp.zeros((HpP, WpP, C), jnp.float32)
+           for pi in range(sh) for pj in range(sw)}
+    for ki in range(wh):
+        a, pi = ki // sh, ki % sh
+        xk_h = xp.reshape(HpP, sh, WpP * sw, C)[a:a + Ho, pi]
+        for kj in range(ww):
+            b, pj = kj // sw, kj % sw
+            xk = xk_h.reshape(Ho, WpP, sw, C)[:, b:b + Wo, pj, :]
+            # f32 compare: v5e's VPU has no bf16 cmp ("Target does not
+            # support this comparison"); the upcast is exact so equality
+            # is unchanged
+            contrib = jnp.where(
+                xk.astype(jnp.float32) == y.astype(jnp.float32), dy, 0.0)
+            acc[(pi, pj)] = acc[(pi, pj)] + lax.pad(
+                contrib, jnp.float32(0),
+                ((a, HpP - a - Ho, 0), (b, WpP - b - Wo, 0), (0, 0, 0)))
+    # interleave phases back to the input grid, one dim at a time
+    cols = [jnp.stack([acc[(pi, pj)] for pj in range(sw)],
+                      axis=2).reshape(HpP, WpP * sw, C)
+            for pi in range(sh)]
+    full = jnp.stack(cols, axis=1).reshape(HpP * sh, WpP * sw, C)
+    dx_ref[0] = full[plh:plh + H, plw:plw + W, :].astype(x.dtype)
+
+
+def _channel_tile(H: int, W: int, C: int, taps: int) -> int:
+    # Pallas requires the lane block be a multiple of 128 or the full C
+    per_c = H * W * taps * _STACK_BYTES_PER_ELEM_TAP
+    candidates = [C] + [m for m in (512, 384, 256, 128) if C % m == 0]
+    fitting = [ct for ct in candidates if ct * per_c <= _BUDGET]
+    return max(fitting) if fitting else 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def max_pool(x, window=(3, 3), strides=(2, 2), padding="SAME"):
+    """Drop-in ``nn.max_pool`` with the Pallas VMEM backward.
+
+    Forward is XLA's ``reduce_window`` (already optimal); only the VJP
+    is replaced.  Falls back to the XLA VJP off-TPU-shapes (see
+    ``_channel_tile``).
+    """
+    return _pool_fwd_raw(x, window, strides, padding)
+
+
+def _pool_fwd_raw(x, window, strides, padding):
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min,
+        lax.max, (1, *window, 1), (1, *strides, 1), padding)
+
+
+def _pool_fwd(x, window, strides, padding):
+    y = _pool_fwd_raw(x, window, strides, padding)
+    return y, (x, y)
+
+
+def _pool_bwd(window, strides, padding, res, dy):
+    x, y = res
+    B, H, W, C = x.shape
+    Ho, Wo, plh, plw = _pool_dims(x.shape, window, strides, padding)
+    ct = _channel_tile(H, W, C, window[0] * window[1])
+    if ct == 0 or window[0] < strides[0] or window[1] < strides[1]:
+        # shape out of kernel range (stride > window would need negative
+        # high pads — the skipped-input-rows case): XLA's own
+        # select-and-scatter VJP
+        _, vjp = jax.vjp(
+            lambda v: _pool_fwd_raw(v, window, strides, padding), x)
+        return (vjp(dy.astype(y.dtype))[0],)
+    kernel = functools.partial(
+        _bwd_kernel, window=window, strides=strides, pads=(plh, plw),
+        out_dims=(Ho, Wo))
+    dx = pl.pallas_call(
+        kernel,
+        grid=(B, C // ct),
+        in_specs=[
+            pl.BlockSpec((1, H, W, ct), lambda b, c: (b, 0, 0, c)),
+            pl.BlockSpec((1, Ho, Wo, ct), lambda b, c: (b, 0, 0, c)),
+            pl.BlockSpec((1, Ho, Wo, ct), lambda b, c: (b, 0, 0, c)),
+        ],
+        out_specs=pl.BlockSpec((1, H, W, ct), lambda b, c: (b, 0, 0, c)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        # Mosaic's stack accounting for the per-tap pad temporaries runs
+        # ~10x the live set; v5e has 128M physical VMEM and the default
+        # 16M scoped limit is what overflows — raise it instead of
+        # shrinking the lane tile
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
+        interpret=_interpret(),
+    )(x, y, dy.astype(y.dtype))
+    return (dx,)
+
+
+max_pool.defvjp(_pool_fwd, _pool_bwd)
