@@ -50,7 +50,7 @@ DEFAULT_MATRIX = [
     ("vit_l16", 256),
     ("inception4", 64),
     ("bert_base", 1024),
-    ("bert_large", 32),
+    ("bert_large", 1024),
     ("gpt2", 128),
     ("gpt2_medium", 32),
     # round 5: the bf16 accumulator unlocked batch scaling past the
@@ -74,6 +74,7 @@ EXTRA_FLAGS = {
                  "--gradient_accumulation_steps=64", "--accum_dtype=bf16"],
     "llama_1b": ["--attention_impl=flash"],
     "bert_base": ["--gradient_accumulation_steps=8"],
+    "bert_large": ["--gradient_accumulation_steps=32"],
     "vit_b16": ["--gradient_accumulation_steps=4"],
     "vit_l16": ["--gradient_accumulation_steps=4"],
 }
