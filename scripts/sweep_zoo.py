@@ -16,43 +16,45 @@ import subprocess
 import sys
 import time
 
-# (model, per-chip batch) — batch chosen to fill HBM without OOM, mirroring
-# tf_cnn_benchmarks' per-model defaults where it has them.
+# (model, per-chip batch) — each entry is the member's BEST-KNOWN config
+# (BASELINE.md zoo table) and is only valid TOGETHER with its EXTRA_FLAGS
+# entry below: the accumulation members' batches exceed HBM as plain
+# one-shot batches and fit only as accum microbatches.  Members without
+# an EXTRA_FLAGS entry run plain batches chosen to fill HBM without OOM,
+# mirroring tf_cnn_benchmarks' per-model defaults where it has them.
 DEFAULT_MATRIX = [
     ("trivial", 512),
     ("lenet", 2048),
-    ("alexnet", 512),
-    ("overfeat", 256),
+    ("alexnet", 2048),
+    ("overfeat", 4096),
     ("googlenet", 256),
     ("mobilenet", 256),
     ("nasnet", 128),
-    ("nasnetlarge", 16),
+    ("nasnetlarge", 128),
     ("densenet40_k12", 512),
     ("densenet100_k12", 256),
     ("resnet18", 256),
     ("resnet34", 256),
     ("resnet50", 128),
-    ("resnet101", 128),
-    ("resnet152", 64),
-    ("resnet50_v2", 128),
-    ("resnet101_v2", 128),
-    ("resnet152_v2", 64),
+    ("resnet101", 512),
+    ("resnet152", 512),
+    ("resnet50_v2", 1024),
+    ("resnet101_v2", 512),
+    ("resnet152_v2", 512),
     ("resnet20_cifar", 1024),
     ("resnet56_cifar", 512),
     ("resnet110_cifar", 256),
-    ("vgg11", 128),
-    ("vgg16", 128),
-    ("vgg19", 128),
+    ("vgg11", 1024),
+    ("vgg16", 1024),
+    ("vgg19", 1024),
     ("inception3", 128),
-    # round-4/5 best-known configs: the transformer members run their
-    # accumulation optima (EXTRA_FLAGS below; BASELINE.md zoo table)
     ("vit_b16", 256),
-    ("vit_l16", 256),
-    ("inception4", 64),
+    ("vit_l16", 512),
+    ("inception4", 512),
     ("bert_base", 1024),
     ("bert_large", 1024),
     ("gpt2", 128),
-    ("gpt2_medium", 32),
+    ("gpt2_medium", 64),
     # round 5: the bf16 accumulator unlocked batch scaling past the
     # bs=16 OOM wall (microbatch 8; BASELINE.md round 5) — +37%
     ("gpt2_moe", 512),
@@ -69,14 +71,27 @@ DEFAULT_MATRIX = [
 EXTRA_FLAGS = {
     "gpt2": ["--attention_impl=flash", "--gradient_accumulation_steps=8"],
     "gpt2_medium": ["--attention_impl=flash",
-                    "--gradient_accumulation_steps=8"],
+                    "--gradient_accumulation_steps=16"],
     "gpt2_moe": ["--attention_impl=flash",
                  "--gradient_accumulation_steps=64", "--accum_dtype=bf16"],
     "llama_1b": ["--attention_impl=flash"],
     "bert_base": ["--gradient_accumulation_steps=8"],
     "bert_large": ["--gradient_accumulation_steps=32"],
     "vit_b16": ["--gradient_accumulation_steps=4"],
-    "vit_l16": ["--gradient_accumulation_steps=4"],
+    "vit_l16": ["--gradient_accumulation_steps=8"],
+    "vgg16": ["--gradient_accumulation_steps=8"],
+    "vgg11": ["--gradient_accumulation_steps=8"],
+    "inception4": ["--gradient_accumulation_steps=8"],
+    "resnet101": ["--gradient_accumulation_steps=8"],
+    "resnet152": ["--gradient_accumulation_steps=8"],
+    "resnet50_v2": ["--gradient_accumulation_steps=8"],
+    "resnet101_v2": ["--gradient_accumulation_steps=8"],
+    "resnet152_v2": ["--gradient_accumulation_steps=8"],
+    "nasnetlarge": ["--gradient_accumulation_steps=8"],
+    # round 5: the big-FC conv members amortize optimizer traffic too
+    "alexnet": ["--gradient_accumulation_steps=4"],
+    "overfeat": ["--gradient_accumulation_steps=16"],
+    "vgg19": ["--gradient_accumulation_steps=8"],
 }
 
 
