@@ -25,6 +25,7 @@ def main() -> int:
     # debug/CI escape hatch: BENCH_FORCE_CPU=1 runs the identical protocol
     # on a virtual 8-device CPU mesh (numbers meaningless, plumbing real)
     if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -11,62 +11,37 @@ A 2-virtual-device single-process mesh compiles the identical program
 the two-process world=2 run executes (same mesh shape, same partitioner
 input), so the crossing counts need no hardware and no second process.
 
+Round 6: the counting moved into ``tpu_hc_bench.analysis.hlo`` and got
+correct (ADVICE r5): the old whole-text regex also matched operand
+references (every consumer of %all-reduce.N re-mentions the name) and
+the ``-done`` halves of async pairs, inflating absolute counts; the
+parser counts *definition sites* only and folds ``-start``/``-done``
+into one op.  This script is now a thin wrapper — the same counts for
+any member come from::
+
+    JAX_PLATFORMS=cpu python -m tpu_hc_bench.analysis --model <name>
+
 Usage: JAX_PLATFORMS=cpu python scripts/exp_hlo_collectives_r05.py
 """
 
 from __future__ import annotations
 
-import re
 import sys
 
-import jax
+sys.path.insert(0, ".")
+
+import tpu_hc_bench  # noqa: F401, E402  (JAX version shims before config)
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 2)
 
-sys.path.insert(0, ".")
-
-import jax.numpy as jnp  # noqa: E402
-
-from tpu_hc_bench import flags  # noqa: E402
-from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens  # noqa: E402
-from tpu_hc_bench.models import create_model, get_model_spec  # noqa: E402
-from tpu_hc_bench.topology import build_mesh, compute_layout  # noqa: E402
-from tpu_hc_bench.train import step as step_mod  # noqa: E402
-
-COLLECTIVE_RE = re.compile(
-    r"\b(all-reduce(?:-start)?|all-gather(?:-start)?|"
-    r"reduce-scatter|collective-permute(?:-start)?|all-to-all)\b")
+from tpu_hc_bench.analysis import hlo  # noqa: E402
 
 
 def count_collectives(model_name: str, batch: int) -> dict[str, int]:
-    cfg = flags.BenchmarkConfig(model=model_name, batch_size=batch).resolve()
-    layout = compute_layout(num_hosts=1, workers_per_host=2,
-                            chips_per_host=2)
-    mesh = build_mesh(layout)
-    spec = get_model_spec(model_name)
-    model, spec = create_model(model_name, dtype=jnp.bfloat16)
-    if spec.is_text:
-        raw = SyntheticTokens(batch * 2, spec.input_shape[0],
-                              vocab_size=spec.vocab_size,
-                              causal_lm=spec.causal_lm).batch()
-    else:
-        raw = SyntheticImages(batch * 2, spec.input_shape,
-                              num_classes=cfg.num_classes).batch()
-    state = step_mod.make_train_state(model, cfg, raw)
-    state = step_mod.replicate_state(state, mesh)
-    dev_batch = step_mod.shard_batch(raw, mesh)
-    step_fn = step_mod.build_train_step(mesh, cfg, spec)
-    # the builder returns a wrapper around its jitted shard_map; jitting
-    # the wrapper inlines it, giving a lowerable handle on the SAME program
-    compiled = (jax.jit(step_fn)
-                .lower(state, dev_batch, jax.random.PRNGKey(0)).compile())
-    text = compiled.as_text()
-    counts: dict[str, int] = {}
-    for m in COLLECTIVE_RE.finditer(text):
-        op = m.group(1).replace("-start", "")
-        counts[op] = counts.get(op, 0) + 1
-    return counts
+    text = hlo.lower_world_step_hlo(model_name, batch=batch, world=2)
+    return hlo.collective_counts(text)
 
 
 def main() -> int:
@@ -75,8 +50,8 @@ def main() -> int:
     for name, bs in (("resnet20_cifar", 64), ("bert_tiny", 32)):
         counts = count_collectives(name, bs)
         total = sum(counts.values())
-        print(f"{name} bs={bs} world=2 optimized-HLO collectives: "
-              f"{total}  {counts}")
+        print(f"{name} bs={bs} world=2 optimized-HLO collectives "
+              f"(definition sites, async pairs folded): {total}  {counts}")
     return 0
 
 

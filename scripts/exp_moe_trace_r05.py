@@ -11,8 +11,21 @@ with a measured decomposition: it traces gpt2_moe under BOTH
     used as RATIOS only — tunnel rule, see exp_vit_trace.py docstring),
   - the dispatch decomposition: what fraction of the step is routing
     work (sort/gather/scatter/cumsum), what is the expert matmul itself
-    (``ragged_dot`` vs the einsum dispatch matmuls), and the implied MXU
-    efficiency of each arm's expert-FLOP execution.
+    (``ragged_dot`` vs the einsum dispatch matmuls), and the router /
+    attention / other matmul split.
+
+Round 6: the matmul split is attributed through the compiled HLO's
+``metadata op_name`` paths (``tpu_hc_bench.analysis.hlo``), not event
+names — ADVICE r5 flagged the old ``"dot" in name`` test as
+fusion-blind: XLA fuses most dots into ``loop_fusion.N`` events whose
+names say nothing, so the substring heuristic attributed near-zero
+expert time.  Each traced event is looked up in the entry computation
+of the SAME program's optimized HLO (same builder, see
+exp_vit_trace.build_step), and the dots its fused computation executes
+are classified by their jax op paths (``.../moe/router/...`` = router,
+``.../moe/...`` = expert, ``.../MultiHeadAttention.../...`` =
+attention).  Events the HLO does not know are reported as an
+unattributed fraction rather than silently dropped.
 
 Usage: python scripts/exp_moe_trace_r05.py [--batch 8] [--model gpt2_moe]
 """
@@ -25,7 +38,63 @@ import sys
 sys.path.insert(0, ".")
 sys.path.insert(0, "scripts")
 
-from exp_vit_trace import classify, device_op_times, run_once, TRACED
+from exp_vit_trace import (classify, device_op_times, run_once,
+                           step_hlo_text, TRACED)
+
+from tpu_hc_bench.analysis import hlo
+
+# leaf opcodes that are MXU matmul work (ragged-dot is the ragged arm's
+# grouped expert matmul; plain dot covers einsum dispatch + attention)
+_MATMUL_OPCODES = ("dot", "ragged-dot")
+
+
+def matmul_class(paths: list[str]) -> str:
+    """One traced event's matmul class from its dots' jax op paths."""
+    classes = set()
+    for p in paths:
+        if "/router/" in p:
+            classes.add("router-matmul")
+        elif "/moe/" in p or "moe." in p:
+            classes.add("expert-matmul")
+        elif "attention" in p.lower() or "attn" in p.lower():
+            classes.add("attention-matmul")
+        else:
+            classes.add("other-matmul")
+    if len(classes) == 1:
+        return classes.pop()
+    return "mixed-matmul"
+
+
+def attribute_matmuls(ops: dict[str, float],
+                      module: hlo.HloModule) -> dict[str, float]:
+    """Split traced device time by HLO-metadata matmul class.
+
+    ``ops`` maps trace event name -> device us; event names are XLA
+    entry-instruction names, so each is looked up at its definition and
+    the dots its (possibly fused) computation executes decide the class.
+    Events carrying no dots land in "non-matmul"; events the HLO text
+    does not define land in "unattributed" (loudly — a nonzero fraction
+    means the lowered program diverged from the traced one).
+    """
+    # entry_only=False: the ragged arm's chunked dispatch (lax.map over
+    # >8192-row token blocks) executes its ragged_dots inside a while
+    # BODY computation — entry-only attribution would class that expert
+    # time "non-matmul", the exact under-attribution this script fixes
+    attr = hlo.op_attribution(module, opcodes=_MATMUL_OPCODES,
+                              entry_only=False)
+    known = {ins.name for comp in module.computations.values()
+             for ins in comp.instructions}
+    out: dict[str, float] = {}
+    for name, us in ops.items():
+        key = name.lstrip("%")
+        if key in attr:
+            cls = matmul_class(attr[key])
+        elif key in known:
+            cls = "non-matmul"
+        else:
+            cls = "unattributed"
+        out[cls] = out.get(cls, 0.0) + us
+    return out
 
 
 def main(argv=None) -> int:
@@ -55,13 +124,16 @@ def main(argv=None) -> int:
         print("  -- class fractions --")
         for c, u in sorted(cls.items(), key=lambda kv: -kv[1]):
             print(f"    {c:>17s}: {u / total:5.1%}")
-        expert_frac = sum(
-            u for n, u in ops.items()
-            if "ragged" in n.lower()
-            or ("fusion" not in n.lower() and "dot" in n.lower()))
+        # HLO-metadata matmul decomposition (same program, re-lowered)
+        module = hlo.parse_hlo(step_hlo_text(
+            args.model, args.batch, attention_impl="flash", moe_impl=impl))
+        split = attribute_matmuls(ops, module)
         routing_frac = cls.get("gather/sort", 0.0)
-        print(f"  routing (sort/gather/scatter): {routing_frac/total:5.1%}"
-              f"   raw-dot ops: {expert_frac/total:5.1%}")
+        print(f"  routing (sort/gather/scatter): {routing_frac/total:5.1%}")
+        print("  -- matmul split (HLO metadata op_name, through fusions) --")
+        for c, u in sorted(split.items(), key=lambda kv: -kv[1]):
+            if c != "non-matmul":
+                print(f"    {c:>17s}: {u / total:5.1%}")
 
     a, b = results["einsum"], results["ragged"]
     print(f"\nstep-time ratio ragged/einsum: {b[0] / a[0]:.3f}x "

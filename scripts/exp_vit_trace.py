@@ -81,9 +81,14 @@ def synthetic_batch(spec, model, batch: int):
     return SyntheticImages(batch, spec.input_shape).batch()
 
 
-def run_once(model_name: str, batch: int, trace_dir: str,
-             attention_impl: str = "dense", moe_impl: str = "einsum",
-             accum: int = 1, accum_dtype: str = "f32"):
+def build_step(model_name: str, batch: int,
+               attention_impl: str = "dense", moe_impl: str = "einsum",
+               accum: int = 1, accum_dtype: str = "f32"):
+    """The traced program, built once: the jitted train step + placed
+    state/batch on the discovered mesh.  Shared by the timing/tracing
+    path below and by exp_moe_trace_r05's HLO lowering, so the program
+    whose compiled text attributes the trace is the SAME program the
+    trace measured."""
     cfg = flags.BenchmarkConfig(model=model_name, batch_size=batch,
                                 attention_impl=attention_impl,
                                 moe_impl=moe_impl,
@@ -105,6 +110,27 @@ def run_once(model_name: str, batch: int, trace_dir: str,
     state = step_mod.replicate_state(state, mesh)
     train_step = step_mod.build_train_step(mesh, cfg, spec)
     dev_batch = step_mod.shard_batch(raw, mesh)
+    return train_step, state, dev_batch
+
+
+def step_hlo_text(model_name: str, batch: int, **build_kw) -> str:
+    """Optimized-HLO text of the program run_once traces (same build).
+
+    The builder's wrapper closes over its jitted shard_map; jitting the
+    wrapper inlines it, giving a lowerable handle on the SAME program.
+    """
+    train_step, state, dev_batch = build_step(model_name, batch, **build_kw)
+    return (jax.jit(train_step)
+            .lower(state, dev_batch, jax.random.PRNGKey(0))
+            .compile().as_text())
+
+
+def run_once(model_name: str, batch: int, trace_dir: str,
+             attention_impl: str = "dense", moe_impl: str = "einsum",
+             accum: int = 1, accum_dtype: str = "f32"):
+    train_step, state, dev_batch = build_step(
+        model_name, batch, attention_impl=attention_impl,
+        moe_impl=moe_impl, accum=accum, accum_dtype=accum_dtype)
     rng = jax.random.PRNGKey(0)
     for _ in range(WARMUP):
         state, metrics = train_step(state, dev_batch, rng)
@@ -150,19 +176,25 @@ def device_op_times(trace_dir: str) -> tuple[dict[str, float],
     # region) and would double-count its children — attribution wants
     # leaf ops only.  The old name heuristic (`isdigit()` / `jit_`
     # prefix) silently counted any differently-named container as a
-    # leaf.  Containers live on SEPARATE tids from the ops they span
-    # (the step track vs the op track), so nesting is tested across ALL
-    # tracks of a device pid: an event strictly containing >= 2 other
-    # events is a container (the >= 2 threshold keeps identical-interval
-    # op pairs, which "contain" each other once).
-    by_pid: dict[int, list] = defaultdict(list)
+    # leaf.  Round 6 (ADVICE r5): containment is tested WITHIN one
+    # (pid, tid) track only — a genuinely long leaf op on one track
+    # merely *overlapping* >= 2 short ops on a sibling track (e.g. a
+    # concurrent DMA/stream track) is real device time, not a container,
+    # and the old cross-tid test silently dropped it.  Containers that
+    # matter for double-counting are the ones sharing a track with their
+    # children; an envelope living alone on its own track contains
+    # nothing on that track and is kept — which only inflates the count
+    # of tracks that carry no leaf ops at all, a far smaller error than
+    # dropping measured leaf time.  The >= 2 threshold keeps
+    # identical-interval op pairs, which "contain" each other once.
+    by_track: dict[tuple, list] = defaultdict(list)
     for e in events:
         if (e.get("ph") == "X" and e.get("pid") in device_pids
                 and e.get("dur", 0) > 0):
-            by_pid[e["pid"]].append(e)
+            by_track[(e["pid"], e.get("tid", 0))].append(e)
     ops: dict[str, float] = defaultdict(float)
     counts: dict[str, int] = defaultdict(int)
-    for evs in by_pid.values():
+    for evs in by_track.values():
         evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
         n = len(evs)
         for i, e in enumerate(evs):

@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 from tpu_hc_bench import flags
+from tpu_hc_bench._compat import CAPABILITIES
 from tpu_hc_bench.train import driver
 
 
@@ -70,10 +71,15 @@ def test_save_model_steps_periodic(mesh8, tmp_path):
     assert ckpt.latest_step(train_dir) == 5
 
 
+@pytest.mark.slow
 def test_dp_checkpoint_resumes_under_pp(mesh8, tmp_path):
     """The DP<->DPxPP interchange through the CLI surface: train DP with
     --train_dir, then continue the same checkpoint under
-    --pipeline_parallel, then eval it under DP again."""
+    --pipeline_parallel, then eval it under DP again.
+
+    Slow lane: three full driver compiles for an interchange whose
+    restack mechanism is pinned numerically (to 1e-5) by the default-lane
+    test of the same name in test_checkpoint_interchange.py."""
     train_dir = str(tmp_path / "interchange")
     out = []
     cfg = tiny_cfg(model="moe_tiny", batch_size=4, train_dir=train_dir)
@@ -157,6 +163,10 @@ def test_eval_under_pp_matches_dp(mesh8, tmp_path):
                                rtol=1e-4)
 
 
+@pytest.mark.skipif(
+    not CAPABILITIES["partial_auto_shard_map"],
+    reason="this jax's SPMD partitioner cannot compile the partial-manual "
+           "SP eval arm (PartitionId unimplemented)")
 def test_eval_under_sp_matches_dp(mesh8, tmp_path):
     """Round 3: --eval under --sequence_parallel — the (data, seq)
     shard_map eval arm reports the same top-1/loss as DP eval of the same
@@ -187,9 +197,14 @@ def test_eval_under_sp_matches_dp(mesh8, tmp_path):
                                rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_eval_under_ep_matches_dp(mesh8, tmp_path):
     """--eval --expert_parallel rides the same follow-inputs GSPMD arm as
-    TP eval; parity vs DP eval of the same MoE checkpoint."""
+    TP eval; parity vs DP eval of the same MoE checkpoint.
+
+    Slow lane: the suite's second-heaviest compile, and the GSPMD eval
+    arm it exercises is the same one test_eval_under_tp_matches_dp pins
+    in the default lane."""
     train_dir = str(tmp_path / "ep_eval")
     cfg = tiny_cfg(model="moe_tiny", batch_size=2, train_dir=train_dir)
     driver.run_benchmark(cfg, print_fn=lambda _: None)

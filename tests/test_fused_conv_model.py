@@ -12,6 +12,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_hc_bench.models import resnet
 
@@ -124,7 +125,11 @@ def test_fused_block_matches_plain():
                 err_msg=f"train={train} strides={strides}")
 
 
+@pytest.mark.slow
 def test_fused_resnet_through_driver(mesh8):
+    # slow lane: the heaviest single compile+run in the suite for a path
+    # recorded as a whole-model NULL (BASELINE.md); block-level fused==
+    # plain parity stays in the default lane above
     from tpu_hc_bench import flags
     from tpu_hc_bench.train import driver
 

@@ -22,10 +22,19 @@ import pytest
 # price of the advertised `pytest` command actually exercising the
 # distributed path (round-3 verdict, next-round item 8).
 
+from tpu_hc_bench._compat import CAPABILITIES
+
+pytestmark = pytest.mark.skipif(
+    not CAPABILITIES["cpu_multiprocess_collectives"],
+    reason="this jax's CPU backend cannot execute cross-process "
+           "collectives (XLA: 'Multiprocess computations aren't "
+           "implemented on the CPU backend')")
+
 REPO = Path(__file__).resolve().parent.parent
 
 WORKER = textwrap.dedent("""
     import os, sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -63,6 +72,7 @@ WORKER = textwrap.dedent("""
 
 PP_WORKER = textwrap.dedent("""
     import os, sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -101,6 +111,7 @@ PP_WORKER = textwrap.dedent("""
 
 TP_WORKER = textwrap.dedent("""
     import os, sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -141,6 +152,7 @@ TP_WORKER = textwrap.dedent("""
 
 DCN_WORKER = textwrap.dedent("""
     import os, sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -183,6 +195,7 @@ DCN_WORKER = textwrap.dedent("""
 
 CKPT_WORKER = textwrap.dedent("""
     import os, sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -223,6 +236,7 @@ CKPT_WORKER = textwrap.dedent("""
 
 SHARDED_CKPT_WORKER = textwrap.dedent("""
     import sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -335,6 +349,7 @@ def test_two_process_hostfile_allreduce(tmp_path):
 
 HOST_FABRIC_WORKER = textwrap.dedent("""
     import sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -402,6 +417,7 @@ def test_two_process_checkpoint_roundtrip(tmp_path):
 
 TP_CKPT_WORKER = textwrap.dedent("""
     import sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -435,6 +451,7 @@ TP_CKPT_WORKER = textwrap.dedent("""
 
 SP_CKPT_WORKER = textwrap.dedent("""
     import sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -504,6 +521,7 @@ def test_two_process_tensor_parallel_step(tmp_path):
 
 PP_NATIVE_CKPT_WORKER = textwrap.dedent("""
     import sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
@@ -557,6 +575,7 @@ def test_two_process_pp_native_train_dir_roundtrip(tmp_path):
 
 SPTP_CKPT_WORKER = textwrap.dedent("""
     import sys
+    import tpu_hc_bench  # noqa: F401  (JAX version shims before config)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)

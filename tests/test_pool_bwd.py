@@ -85,6 +85,43 @@ def test_pool_bwd_stride_gt_window_falls_back():
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref))
 
 
+def test_pool_bwd_neg_inf_input_routes_to_xla():
+    """An input containing -inf ties with the kernel's -inf pad taps
+    (every tied element would get the full cotangent — wrong where the
+    "tie" is padding): the runtime -inf scan must route to the XLA VJP,
+    whose gradient stays finite and matches s&s exactly."""
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 10, 10, 8),
+                          jnp.float32)
+    x = x.at[0, :3, :3, :].set(-jnp.inf)
+    g = jax.grad(lambda v: max_pool(v, (3, 3), (2, 2), "SAME").sum())(x)
+    g_ref = jax.grad(
+        lambda v: nn.max_pool(v, (3, 3), (2, 2), "SAME").sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref))
+
+
+def test_pool_bwd_int_dtype_routes_to_xla():
+    """Non-float dtypes can't encode the kernel's -inf pad identity
+    (jnp.asarray(-inf, int32) raises at trace time), so the VJP rule
+    must route them to the XLA fallback.  JAX's AD never reaches this
+    path through jax.grad (integer primals are rejected upstream), so
+    the rule is exercised directly."""
+    from tpu_hc_bench.ops.pool_bwd import _pool_bwd, _pool_fwd
+
+    x = jax.random.randint(jax.random.PRNGKey(4), (1, 8, 8, 8),
+                           -100, 100, jnp.int32)
+    # forward int pooling is real usage and must match nn.max_pool
+    np.testing.assert_array_equal(
+        np.asarray(max_pool(x, (2, 2), (2, 2), "VALID")),
+        np.asarray(nn.max_pool(x, (2, 2), (2, 2), "VALID")))
+    y, res = _pool_fwd(x, (2, 2), (2, 2), "VALID")
+    (dx,) = _pool_bwd((2, 2), (2, 2), "VALID", res, jnp.ones_like(y))
+    assert dx.dtype == x.dtype
+    g_ref = jax.grad(lambda v: nn.max_pool(
+        v, (2, 2), (2, 2), "VALID").sum())(x.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(g_ref))
+
+
 def test_pool_bwd_fallback_path_matches():
     """A budget-rejected shape still computes the right gradient via
     the XLA fallback inside the custom VJP."""

@@ -1,0 +1,45 @@
+"""Static analysis of compiled programs + lint passes over the zoo.
+
+The reference harness validates its fabric *operationally* (OSU
+microbenchmarks over InfiniBand, run-*.sh); the TPU-native counterpart
+of that validation is *static*: inspect the compiled XLA program and the
+traced jaxpr and assert structural properties — how many collectives
+cross the mesh per step, whether a host sync hides inside a jitted
+region, whether a sharding annotation is inconsistent across a pjit
+boundary.  Before round 6 those checks lived in fragile per-experiment
+regexes (ADVICE.md round 5 flagged three independent miscounting bugs);
+this package is the one reusable home:
+
+- :mod:`tpu_hc_bench.analysis.hlo` — a definition-site parser for HLO
+  text.  Counts ops by parsing ``%name = <shape> opcode(...)`` definition
+  lines only (operand references never match), folds ``-start``/``-done``
+  async pairs into one op, and attributes fused computations through
+  their HLO ``metadata op_name`` paths instead of event-name substrings.
+- :mod:`tpu_hc_bench.analysis.lints` — jaxpr/AST lint passes runnable
+  against every model in the zoo: host-sync-inside-jit, recompilation
+  hazards, donated-buffer misuse, sharding-annotation consistency.
+- :mod:`tpu_hc_bench.analysis.report` — findings, JSON reports, and the
+  checked-in baseline the CI gate (``tests/test_analysis.py`` +
+  ``python -m tpu_hc_bench.analysis``) fails against on regression.
+
+CLI::
+
+    python -m tpu_hc_bench.analysis --model resnet50   # lints + HLO counts
+    python -m tpu_hc_bench.analysis --all --json out.json
+    python -m tpu_hc_bench.analysis --update-baseline
+"""
+
+from tpu_hc_bench.analysis.hlo import (  # noqa: F401
+    COLLECTIVE_OPCODES,
+    HloComputation,
+    HloInstruction,
+    HloModule,
+    collective_counts,
+    fusion_ops,
+    parse_hlo,
+)
+from tpu_hc_bench.analysis.report import (  # noqa: F401
+    Finding,
+    compare_to_baseline,
+    load_baseline,
+)

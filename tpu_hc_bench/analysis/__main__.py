@@ -1,0 +1,146 @@
+"""CLI: ``python -m tpu_hc_bench.analysis``.
+
+Runs the lint passes (and, per model, the world=2 compiled-HLO
+collective count) and compares the findings against the checked-in
+baseline; exits non-zero on any finding the baseline does not accept —
+the CI lint gate.
+
+Examples::
+
+    # one member: lints + definition-site collective counts
+    JAX_PLATFORMS=cpu python -m tpu_hc_bench.analysis --model resnet50
+
+    # the whole zoo's lints + the repo source passes, JSON to a file
+    JAX_PLATFORMS=cpu python -m tpu_hc_bench.analysis --all --json out.json
+
+    # accept the current tree's findings as the new baseline
+    JAX_PLATFORMS=cpu python -m tpu_hc_bench.analysis --all --update-baseline
+
+The collective count lowers the member's real world=2 train step on a
+2-virtual-device CPU mesh (identical program to a two-process run; see
+``hlo.lower_world_step_hlo``), so ``--collectives`` runs want
+``JAX_PLATFORMS=cpu`` and take compile time; ``--no-collectives`` skips
+them for lint-only runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _configure_cpu(world: int) -> None:
+    # must precede any jax device use; the compat shim reroutes the
+    # option to XLA_FLAGS on old stacks
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", world)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_hc_bench.analysis",
+        description="static analysis + lint gate over the model zoo")
+    ap.add_argument("--model", action="append", default=[],
+                    help="zoo member to analyze (repeatable)")
+    ap.add_argument("--all", action="store_true",
+                    help="analyze every zoo member + repo sources")
+    ap.add_argument("--batch", type=int, default=2,
+                    help="per-device batch for the lowered step "
+                         "(collective counts are batch-invariant)")
+    ap.add_argument("--world", type=int, default=2,
+                    help="virtual device count for the lowered step")
+    ap.add_argument("--collectives", dest="collectives",
+                    action="store_true", default=None,
+                    help="count collectives in the compiled world=N HLO "
+                         "(default: on for --model, off for --all)")
+    ap.add_argument("--no-collectives", dest="collectives",
+                    action="store_false")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full JSON report here ('-' = stdout)")
+    ap.add_argument("--baseline", metavar="PATH",
+                    help="baseline findings file (default: checked-in)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run's findings")
+    args = ap.parse_args(argv)
+
+    from tpu_hc_bench.models import list_models
+
+    models = list(args.model)
+    if args.all:
+        models = list_models()
+    if not models and not args.all:
+        ap.error("pass --model NAME (repeatable) or --all")
+    count_collectives = args.collectives
+    if count_collectives is None:
+        count_collectives = bool(args.model) and not args.all
+
+    if count_collectives:
+        _configure_cpu(args.world)
+
+    from tpu_hc_bench.analysis import hlo, lints, report
+
+    findings = []
+    collectives: dict[str, dict[str, int]] = {}
+    findings.extend(lints.lint_repo_sources())
+    for name in models:
+        print(f"-- {name}", file=sys.stderr)
+        findings.extend(lints.lint_model(name))
+        if count_collectives:
+            text = hlo.lower_world_step_hlo(name, batch=args.batch,
+                                            world=args.world)
+            collectives[name] = hlo.collective_counts(text)
+
+    rep = report.Report(findings=findings, collectives=collectives)
+    if args.json == "-":
+        sys.stdout.write(rep.to_json())
+    elif args.json:
+        with open(args.json, "w") as f:
+            f.write(rep.to_json())
+
+    # human summary: stderr when stdout is the JSON stream
+    out = sys.stderr if args.json == "-" else sys.stdout
+    for name, counts in sorted(collectives.items()):
+        total = sum(counts.values())
+        print(f"{name} world={args.world} optimized-HLO collectives "
+              f"(definition sites, async pairs folded): {total}  {counts}",
+              file=out)
+
+    if args.update_baseline:
+        path = args.baseline or report.BASELINE_PATH
+        # a partial (--model) run only ADDS keys; erasing other models'
+        # accepted findings requires the full --all picture
+        merge = set() if args.all else report.load_baseline(path)
+        report.save_baseline(findings, path, merge=merge)
+        print(f"baseline updated: {path} "
+              f"({len({f.key for f in findings} | merge)} accepted keys)",
+              file=out)
+        return 0
+
+    baseline = report.load_baseline(args.baseline or report.BASELINE_PATH)
+    regressions = report.compare_to_baseline(findings, baseline)
+    for f in regressions:
+        print(f.render(), file=sys.stderr)
+    if regressions:
+        print(f"{len(regressions)} finding(s) not in baseline "
+              f"(accept with --update-baseline or suppress with "
+              f"`# thb:lint-ok[<lint>]`)", file=sys.stderr)
+        return 1
+    n_info = sum(1 for f in findings if f.severity == "info")
+    print(f"analysis clean: {len(findings)} finding(s), all accepted "
+          f"({n_info} info)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    # hard-exit: 0.4.x jaxlib can segfault in interpreter teardown after
+    # a lowering (model-dependent; `trivial` reproduces it), which would
+    # overwrite the gate's verdict with 139 — flush and skip teardown so
+    # the exit code is always the comparison result
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

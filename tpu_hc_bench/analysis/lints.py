@@ -1,0 +1,655 @@
+"""jaxpr/AST lint passes over the zoo and the package sources.
+
+Four families of defects this harness has actually hit (or nearly
+shipped) are checked statically:
+
+- **host-sync-in-jit** (error): a host round-trip inside traced code —
+  ``.item()``, ``jax.device_get``, ``block_until_ready``,
+  ``np.array``/``np.asarray`` on a traced value.  At best these bake a
+  constant at trace time; at worst (under real jit) they throw a
+  ``ConcretizationTypeError`` only on the first hardware run.  Checked
+  two ways: over the AST of functions that are traced (passed to
+  ``jax.jit``/``jax.shard_map``/``pallas_call``/``lax`` control flow,
+  flax ``nn.Module`` methods, and anything nested in those), and over
+  the model's jaxpr (``pure_callback``/``io_callback``/host callbacks).
+- **recompile-hazard**: Python-scalar closure leaks — a traced function
+  reading a free variable its enclosing scope *mutates* (for-loop
+  target / augmented assignment), which bakes a different constant per
+  call and recompiles every step (warning) — and shape-dependent
+  branching against numeric literals, which silently forks compilations
+  per shape class (info; shape-vs-shape residual branches are the
+  normal static idiom and do not flag).
+- **donated-buffer-misuse** (warning): a buffer passed in a
+  ``donate_argnums`` position of a jitted call and then read again
+  later in the same scope — donation invalidates it, and XLA's runtime
+  error surfaces far from the offending read.
+- **sharding-consistency** (warning): per model, the Megatron
+  annotation table (``train.step.tp_param_spec``) is replayed against
+  the abstractly-initialized param tree: a rule whose *name* matches a
+  param but whose *rank* doesn't (annotation drift after a model
+  refactor), a model-axis-sharded dimension not divisible by the
+  minimum TP degree, and column/row rule pairs where one direction of a
+  block matched but its partner did not (the asymmetry that makes GSPMD
+  insert per-layer reshards at the pjit boundary).
+
+Suppression: append ``# thb:lint-ok[<lint>]`` to the offending line, or
+accept the finding into the checked-in baseline (see ``report.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import symtable
+from pathlib import Path
+
+from tpu_hc_bench.analysis.report import Finding
+
+__all__ = [
+    "lint_source_text", "lint_file", "lint_repo_sources", "lint_model",
+    "ALL_SOURCE_LINTS",
+]
+
+HOST_SYNC = "host-sync-in-jit"
+RECOMPILE = "recompile-hazard"
+DONATION = "donated-buffer-misuse"
+SHARDING = "sharding-consistency"
+ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION)
+
+# callables whose function-valued arguments are traced (jit contexts)
+_TRACING_CALLEES = {
+    "jit", "pjit", "shard_map", "pallas_call", "checkpoint", "remat",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "vmap", "pmap",
+    "grad", "value_and_grad", "custom_vjp", "custom_jvp",
+}
+# attribute/function calls that force a host round-trip on traced values
+_HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+_HOST_SYNC_FUNCS = {"device_get", "block_until_ready"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_NUMPY_MATERIALIZERS = {"array", "asarray"}
+
+_SUPPRESS_TOKEN = "thb:lint-ok["
+
+
+def _suppressed_lines(source: str) -> dict[int, set[str]]:
+    """``# thb:lint-ok[name]`` annotations, by 1-based line number."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        pos = line.find(_SUPPRESS_TOKEN)
+        while pos != -1:
+            end = line.find("]", pos)
+            if end == -1:
+                break
+            out.setdefault(i, set()).add(
+                line[pos + len(_SUPPRESS_TOKEN):end].strip())
+            pos = line.find(_SUPPRESS_TOKEN, end)
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('jax.jit', 'np.array')."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _callee_basename(call: ast.Call) -> str:
+    name = _dotted(call.func)
+    base = name.rsplit(".", 1)[-1]
+    if base == "partial":  # functools.partial(jax.jit, ...) etc.
+        if call.args:
+            return _callee_basename(
+                call.args[0]) if isinstance(call.args[0], ast.Call) \
+                else _dotted(call.args[0]).rsplit(".", 1)[-1]
+    return base
+
+
+class _FileLinter:
+    """All AST passes over one Python source file."""
+
+    def __init__(self, source: str, filename: str, model: str = "repo"):
+        self.source = source
+        self.filename = filename
+        self.model = model
+        self.tree = ast.parse(source, filename=filename)
+        self.suppressed = _suppressed_lines(source)
+        try:
+            self.symtab = symtable.symtable(source, filename, "exec")
+        except Exception:
+            self.symtab = None
+        # parent links + enclosing-function chains
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self.findings: list[Finding] = []
+
+    # -- shared helpers ------------------------------------------------
+
+    def _emit(self, lint: str, severity: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 0)
+        if lint in self.suppressed.get(line, ()):
+            return
+        self.findings.append(Finding(
+            lint=lint, severity=severity, model=self.model,
+            location=f"{self.filename}:{line}", message=message))
+
+    def _enclosing_functions(self, node: ast.AST) -> list[ast.AST]:
+        chain = []
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                chain.append(cur)
+            cur = self._parents.get(cur)
+        return chain
+
+    def _is_flax_module_class(self, cls: ast.ClassDef) -> bool:
+        for base in cls.bases:
+            name = _dotted(base)
+            if name.endswith("Module") or name in ("nn.Module",):
+                return True
+        return False
+
+    # -- jit-context discovery ----------------------------------------
+
+    def _jit_contexts(self) -> list[ast.AST]:
+        """FunctionDefs whose bodies run under trace.
+
+        A function is a jit context if it is (a) decorated with a tracing
+        transform or ``nn.compact``, (b) referenced by name as an
+        argument to a tracing callee (``jax.jit(f)``,
+        ``jax.shard_map(step, ...)``, ``lax.scan(body, ...)``,
+        ``pl.pallas_call(kernel, ...)`` — including through
+        ``functools.partial(kernel, ...)``), (c) a method of a flax
+        ``nn.Module`` subclass named ``__call__``/``setup``, or (d)
+        nested inside any of those.
+        """
+        traced_names: set[str] = set()   # function names used as traced args
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base = _callee_basename(node)
+            args = list(node.args)
+            if base in _TRACING_CALLEES:
+                for a in args:
+                    if isinstance(a, ast.Name):
+                        traced_names.add(a.id)
+                    elif isinstance(a, ast.Call) and \
+                            _callee_basename(a) == "partial":
+                        for pa in a.args:
+                            if isinstance(pa, ast.Name):
+                                traced_names.add(pa.id)
+
+        contexts: list[ast.AST] = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            is_ctx = node.name in traced_names
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                base = _dotted(target).rsplit(".", 1)[-1]
+                if base in _TRACING_CALLEES or base == "compact":
+                    is_ctx = True
+                if base == "partial" and isinstance(dec, ast.Call) \
+                        and dec.args:
+                    if _dotted(dec.args[0]).rsplit(".", 1)[-1] \
+                            in _TRACING_CALLEES:
+                        is_ctx = True
+            parent = self._parents.get(node)
+            if isinstance(parent, ast.ClassDef) \
+                    and self._is_flax_module_class(parent) \
+                    and node.name in ("__call__", "setup"):
+                is_ctx = True
+            if is_ctx:
+                contexts.append(node)
+        # close over nesting: functions defined inside a context trace too
+        closed: list[ast.AST] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node in contexts or any(
+                        f in contexts for f in
+                        self._enclosing_functions(node)):
+                    closed.append(node)
+        return closed
+
+    # -- pass: host sync inside traced code ---------------------------
+
+    def _check_host_sync(self, ctx: ast.AST):
+        for node in ast.walk(ctx):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            base = name.rsplit(".", 1)[-1]
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_SYNC_METHODS \
+                    and not node.args:
+                self._emit(
+                    HOST_SYNC, "error", node,
+                    f".{node.func.attr}() forces a device->host sync at "
+                    f"trace time inside `{getattr(ctx, 'name', '?')}`; "
+                    "return the array and sync outside the jitted region")
+            elif base in _HOST_SYNC_FUNCS and name.startswith(
+                    ("jax.", "device_get", "block_until_ready")):
+                self._emit(
+                    HOST_SYNC, "error", node,
+                    f"{name}() inside traced `{getattr(ctx, 'name', '?')}` "
+                    "is a host round-trip; hoist it out of the jit")
+            elif "." in name and name.split(".", 1)[0] in _NUMPY_ALIASES \
+                    and base in _NUMPY_MATERIALIZERS:
+                self._emit(
+                    HOST_SYNC, "error", node,
+                    f"{name}() materializes a traced value on host inside "
+                    f"`{getattr(ctx, 'name', '?')}`; use jnp instead")
+
+    # -- pass: recompilation hazards ----------------------------------
+
+    def _locals_of(self, func: ast.AST) -> set[str]:
+        """Parameter + locally-bound names of a FunctionDef (via symtable,
+        matched by name and line)."""
+        if self.symtab is None:
+            return set()
+
+        def find(table):
+            if table.get_type() == "function" \
+                    and table.get_name() == getattr(func, "name", None) \
+                    and table.get_lineno() == func.lineno:
+                return table
+            for child in table.get_children():
+                got = find(child)
+                if got is not None:
+                    return got
+            return None
+
+        table = find(self.symtab)
+        if table is None:
+            return set()
+        return {s.get_name() for s in table.get_symbols()
+                if s.is_local() or s.is_parameter()}
+
+    def _free_vars_of(self, func: ast.AST) -> set[str]:
+        if self.symtab is None:
+            return set()
+
+        def find(table):
+            if table.get_type() == "function" \
+                    and table.get_name() == getattr(func, "name", None) \
+                    and table.get_lineno() == func.lineno:
+                return table
+            for child in table.get_children():
+                got = find(child)
+                if got is not None:
+                    return got
+            return None
+
+        table = find(self.symtab)
+        if table is None:
+            return set()
+        return {s.get_name() for s in table.get_symbols() if s.is_free()}
+
+    def _check_recompile(self, ctx: ast.AST):
+        # (a) closure leaks: free vars the enclosing scope mutates
+        free = self._free_vars_of(ctx)
+        if free:
+            for enclosing in self._enclosing_functions(ctx):
+                mutated: dict[str, ast.AST] = {}
+                for node in ast.walk(enclosing):
+                    if isinstance(node, ast.AugAssign) \
+                            and isinstance(node.target, ast.Name):
+                        mutated.setdefault(node.target.id, node)
+                    elif isinstance(node, ast.For) \
+                            and isinstance(node.target, ast.Name):
+                        mutated.setdefault(node.target.id, node)
+                for name in sorted(free & set(mutated)):
+                    self._emit(
+                        RECOMPILE, "warning", mutated[name],
+                        f"traced `{getattr(ctx, 'name', '?')}` closes over "
+                        f"`{name}`, which this scope mutates — each new "
+                        "value bakes a fresh constant and recompiles; pass "
+                        "it as a traced argument instead")
+        # (b) shape-vs-literal branching (shape-vs-shape is the normal
+        # static residual-path idiom and stays silent)
+        for node in ast.walk(ctx):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for cmp in ast.walk(node.test):
+                if not isinstance(cmp, ast.Compare):
+                    continue
+                sides = [cmp.left, *cmp.comparators]
+                shapeish = [s for s in sides if self._mentions_shape(s)]
+                literal = [s for s in sides
+                           if isinstance(s, ast.Constant)
+                           and isinstance(s.value, (int, float))]
+                if shapeish and literal:
+                    self._emit(
+                        RECOMPILE, "info", cmp,
+                        "branching on a shape vs a numeric literal forks "
+                        "one compilation per shape class; make sure every "
+                        "class is intended (use static_argnums/config if "
+                        "it encodes a mode)")
+
+    @staticmethod
+    def _mentions_shape(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr == "shape":
+                return True
+            if isinstance(n, ast.Call) and _dotted(n.func) == "len":
+                return True
+        return False
+
+    # -- pass: donated-buffer misuse ----------------------------------
+
+    @staticmethod
+    def _own_nodes(scope: ast.AST):
+        """Walk a scope WITHOUT descending into nested scopes, so a
+        nested function's parameters never alias this scope's names."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_donation(self):
+        """Within each function scope: a name passed in a donated
+        position of a jitted callable, then *read* again afterwards.
+
+        Only the scope's OWN statements participate — a nested function
+        calling the jitted callable with its own parameters is a fresh
+        binding per call and is fine by construction.
+        """
+        scopes = [self.tree] + [
+            n for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            jitted: dict[str, tuple[int, ...]] = {}
+            for node in self._own_nodes(scope):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and _callee_basename(node.value) in ("jit", "pjit"):
+                    donate = self._donated_positions(node.value)
+                    if donate and len(node.targets) == 1 \
+                            and isinstance(node.targets[0], ast.Name):
+                        jitted[node.targets[0].id] = donate
+            if not jitted:
+                continue
+            self._scan_donation_scope(scope, jitted)
+
+    @staticmethod
+    def _donated_positions(call: ast.Call) -> tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    return tuple(e.value for e in v.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, int))
+        return ()
+
+    def _scan_donation_scope(self, scope: ast.AST,
+                             jitted: dict[str, tuple[int, ...]]):
+        # document-order scan of the scope's OWN statements; per stmt:
+        # flag reads of donated names, then record new donations, then
+        # clear rebound targets (so `state = jitted(state, ...)` — the
+        # idiomatic donate-and-rebind — never flags)
+        stmts: list[ast.stmt] = [n for n in self._own_nodes(scope)
+                                 if isinstance(n, ast.stmt)]
+        stmts.sort(key=lambda n: (n.lineno, n.col_offset))
+        donated_at: dict[str, ast.AST] = {}
+        for stmt in stmts:
+            sub = [stmt] + [n for n in self._own_nodes(stmt)]
+            for node in sub:
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in donated_at:
+                    call = donated_at.pop(node.id)
+                    self._emit(
+                        DONATION, "warning", node,
+                        f"`{node.id}` was donated to a jitted call "
+                        f"(line {call.lineno}) and is read again here "
+                        "— the buffer is invalidated by donation; "
+                        "rebind the result or drop donate_argnums")
+            for node in sub:
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id in jitted:
+                    for pos in jitted[node.func.id]:
+                        if pos < len(node.args) and isinstance(
+                                node.args[pos], ast.Name):
+                            donated_at[node.args[pos].id] = node
+            for tgt in self._assigned_names(stmt):
+                donated_at.pop(tgt, None)
+
+    @staticmethod
+    def _assigned_names(stmt: ast.stmt) -> set[str]:
+        out: set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) \
+                and isinstance(stmt.target, ast.Name):
+            out.add(stmt.target.id)
+        return out
+
+    # -- driver --------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        for ctx in self._jit_contexts():
+            self._check_host_sync(ctx)
+            self._check_recompile(ctx)
+        self._check_donation()
+        return self.findings
+
+
+def lint_source_text(source: str, filename: str = "<string>",
+                     model: str = "repo") -> list[Finding]:
+    """AST lint passes over a source string (the test-fixture entry)."""
+    return _FileLinter(source, filename, model).run()
+
+
+def lint_file(path: str | Path, model: str = "repo") -> list[Finding]:
+    path = Path(path)
+    return lint_source_text(path.read_text(), str(path), model)
+
+
+def lint_repo_sources(root: str | Path | None = None) -> list[Finding]:
+    """AST passes over every package + scripts source file."""
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    root = Path(root)
+    findings: list[Finding] = []
+    for sub in ("tpu_hc_bench", "scripts"):
+        base = root / sub
+        if not base.is_dir():
+            continue
+        for path in sorted(base.rglob("*.py")):
+            try:
+                rel = str(path.relative_to(root))
+            except ValueError:
+                rel = str(path)
+            findings.extend(lint_source_text(path.read_text(), rel))
+    return findings
+
+
+# -- per-model semantic passes (jaxpr + sharding rules) ----------------
+
+# column-parallel -> row-parallel partners: if one side of a transformer
+# block matched a TP rule and the other did not, GSPMD reshards at the
+# block boundary every layer
+_TP_RULE_PARTNERS = [
+    ({"qkv/kernel"}, {"out/kernel"}),
+    ({"Dense_0/kernel"}, {"Dense_1/kernel"}),
+    ({"fc/kernel"}, {"proj/kernel"}),
+    ({"wq/kernel", "wk/kernel", "wv/kernel"}, {"wo/kernel"}),
+    ({"gate/kernel", "up/kernel"}, {"down/kernel"}),
+]
+_MIN_TP_DEGREE = 2
+
+_HOST_CALLBACK_PRIMITIVES = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call",
+}
+
+
+def _abstract_model(name: str):
+    """(model, spec, abstract param tree) without touching device memory."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_hc_bench.models import create_model
+
+    model, spec = create_model(name)
+    if spec.is_text:
+        example = jax.ShapeDtypeStruct((1,) + tuple(spec.input_shape),
+                                       jnp.int32)
+    elif getattr(spec, "integer_input", False):
+        example = jax.ShapeDtypeStruct((1,) + tuple(spec.input_shape),
+                                       jnp.int32)
+    else:
+        example = jax.ShapeDtypeStruct((1,) + tuple(spec.input_shape),
+                                       jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    variables = jax.eval_shape(
+        functools.partial(model.init, train=False), rng, example)
+    return model, spec, variables, example
+
+
+def _param_paths(tree) -> list[tuple[str, tuple[int, ...]]]:
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(getattr(k, "key", str(k)) for k in path)
+        out.append((name, tuple(leaf.shape)))
+    return out
+
+
+def check_sharding_consistency(name: str) -> list[Finding]:
+    """Replay ``tp_param_spec`` over the model's abstract params."""
+    from tpu_hc_bench.topology import MODEL_AXIS
+    from tpu_hc_bench.train.step import tp_param_spec
+
+    findings: list[Finding] = []
+    _, spec, variables, _ = _abstract_model(name)
+    params = variables.get("params", {})
+    paths = _param_paths(params)
+    # the rule table, re-derived: suffix -> expected rank(s)
+    rule_suffixes: dict[str, set[int]] = {}
+    for suffix in {s for pair in _TP_RULE_PARTNERS for side in pair
+                   for s in side} | {"qkv/bias", "Dense_0/bias", "fc/bias",
+                                     "moe/wi", "moe/wo"}:
+        for rank in range(1, 5):
+            p = tp_param_spec(suffix, rank)
+            if len(p) and any(ax == MODEL_AXIS for ax in p):
+                rule_suffixes.setdefault(suffix, set()).add(rank)
+
+    matched_suffixes: set[str] = set()
+    for path, shape in paths:
+        ndim = len(shape)
+        p = tp_param_spec(path, ndim)
+        hit = [s for s in rule_suffixes if path.endswith(s)]
+        if hit and not len(p):
+            want = sorted(r for s in hit for r in rule_suffixes[s])
+            findings.append(Finding(
+                lint=SHARDING, severity="warning", model=name,
+                location=f"param:{path}",
+                message=f"name matches TP rule {hit[0]!r} but rank "
+                        f"{ndim} matches none of its specs (rank(s) "
+                        f"{want}); the rule table has drifted from the "
+                        "model definition and this param silently "
+                        "replicates"))
+            continue
+        if hit:
+            matched_suffixes.update(hit)
+            for dim, ax in enumerate(p):
+                if ax == MODEL_AXIS and shape[dim] % _MIN_TP_DEGREE:
+                    findings.append(Finding(
+                        lint=SHARDING, severity="warning", model=name,
+                        location=f"param:{path}",
+                        message=f"dim {dim} (size {shape[dim]}) is "
+                                f"model-axis-sharded but not divisible "
+                                f"by the minimum TP degree "
+                                f"{_MIN_TP_DEGREE}"))
+    # column/row pairing only means something for the transformer
+    # families the TP table targets; a lone auto-named Dense_0 head in a
+    # CNN matching the BERT FFN rule is incidental (and harmless — TP on
+    # non-transformers is rejected upstream by shard_state_tp)
+    if not (spec.is_text or getattr(spec, "attention", False)):
+        return findings
+    for cols, rows in _TP_RULE_PARTNERS:
+        got_col = bool(cols & matched_suffixes)
+        got_row = bool(rows & matched_suffixes)
+        if got_col != got_row:
+            have, miss = (cols, rows) if got_col else (rows, cols)
+            findings.append(Finding(
+                lint=SHARDING, severity="warning", model=name,
+                location=f"param:{sorted(have)[0]}",
+                message=f"TP rules matched {sorted(have)} but not the "
+                        f"partner direction {sorted(miss)}: the block is "
+                        "half-annotated across the pjit boundary, so "
+                        "GSPMD inserts a reshard every layer"))
+    return findings
+
+
+def check_jaxpr_host_callbacks(name: str) -> list[Finding]:
+    """Trace the model's apply and flag host-callback primitives."""
+    import jax
+
+    findings: list[Finding] = []
+    model, spec, variables, example = _abstract_model(name)
+
+    def fwd(variables, x):
+        return model.apply(variables, x, train=False)
+
+    jaxpr = jax.make_jaxpr(fwd)(variables, example)
+
+    def walk(jx, depth=0):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in _HOST_CALLBACK_PRIMITIVES:
+                findings.append(Finding(
+                    lint=HOST_SYNC, severity="warning", model=name,
+                    location=f"jaxpr:{eqn.primitive.name}",
+                    message=f"model forward traces a "
+                            f"`{eqn.primitive.name}` host callback — a "
+                            "device->host round-trip inside every step"))
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr if hasattr(v.jaxpr, "eqns") else v,
+                         depth + 1)
+                elif isinstance(v, (list, tuple)):
+                    for item in v:
+                        if hasattr(item, "eqns"):
+                            walk(item, depth + 1)
+                        elif hasattr(item, "jaxpr"):
+                            walk(item.jaxpr, depth + 1)
+
+    walk(jaxpr.jaxpr)
+    return findings
+
+
+def lint_model(name: str, source_lints: bool = True) -> list[Finding]:
+    """Every per-model pass: module-source AST + jaxpr + sharding rules."""
+    findings: list[Finding] = []
+    if source_lints:
+        import importlib
+
+        from tpu_hc_bench.models import get_model_spec
+
+        spec = get_model_spec(name)
+        mod = importlib.import_module(spec.create.__module__)
+        path = Path(mod.__file__)
+        for f in lint_file(path, model=name):
+            findings.append(f)
+    findings.extend(check_jaxpr_host_callbacks(name))
+    findings.extend(check_sharding_consistency(name))
+    return findings

@@ -1,0 +1,124 @@
+"""Findings, JSON reports, and the checked-in baseline gate.
+
+A *finding* is one lint hit: ``(lint, severity, location, message)``
+plus the model it was found against (or ``"repo"`` for source-level
+lints that are not per-model).  Findings serialize to stable JSON so CI
+can diff runs, and the repo checks in a baseline
+(``tpu_hc_bench/analysis/baseline_findings.json``) of the findings the
+current tree is *known and accepted* to produce.  The gate
+(``tests/test_analysis.py``, ``python -m tpu_hc_bench.analysis``) fails
+only on findings NOT in the baseline — so adding a new host sync inside
+a jitted region breaks CI, while a deliberate, reviewed exception is one
+baseline entry away.
+
+Suppression: either add the finding's ``key`` to the baseline (the CLI's
+``--update-baseline`` rewrites it from the current tree), or annotate
+the offending source line with ``# thb:lint-ok[<lint-name>]`` which the
+AST lints honor in place.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+
+__all__ = [
+    "Finding", "findings_to_json", "load_baseline", "save_baseline",
+    "compare_to_baseline", "BASELINE_PATH",
+]
+
+BASELINE_PATH = Path(__file__).parent / "baseline_findings.json"
+
+_SEVERITIES = ("error", "warning", "info")
+
+
+@dataclass(frozen=True)
+class Finding:
+    lint: str          # pass name, e.g. "host-sync-in-jit"
+    severity: str      # "error" | "warning" | "info"
+    model: str         # zoo member, or "repo" for source-level passes
+    location: str      # "path/to/file.py:123" or "param:layer_0/qkv"
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Stable identity used for baseline matching.
+
+        Deliberately excludes the message tail and the line number (code
+        motion above a finding must not churn the baseline): identity is
+        the lint, the model, and the location's file/object part.  Only
+        a NUMERIC suffix is stripped — ``param:layer_0/qkv`` and
+        ``jaxpr:pure_callback`` locations keep their full object path,
+        so accepting one sharding finding never masks another.
+        """
+        head, _, tail = self.location.rpartition(":")
+        loc = head if head and tail.isdigit() else self.location
+        return f"{self.lint}::{self.model}::{loc}"
+
+    def render(self) -> str:
+        return (f"[{self.severity}] {self.lint} ({self.model}) "
+                f"{self.location} — {self.message}")
+
+
+@dataclass
+class Report:
+    """Per-run result: findings + any per-model collective counts."""
+
+    findings: list[Finding] = field(default_factory=list)
+    collectives: dict[str, dict[str, int]] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return findings_to_json(self.findings, self.collectives)
+
+
+def findings_to_json(findings: list[Finding],
+                     collectives: dict[str, dict[str, int]] | None = None,
+                     ) -> str:
+    payload = {
+        "findings": [asdict(f) for f in sorted(
+            findings, key=lambda f: (f.model, f.lint, f.location))],
+    }
+    if collectives:
+        payload["collectives"] = {
+            m: dict(sorted(c.items())) for m, c in sorted(collectives.items())
+        }
+    return json.dumps(payload, indent=2, sort_keys=True) + "\n"
+
+
+def load_baseline(path: Path | str = BASELINE_PATH) -> set[str]:
+    """Baseline = the set of accepted finding keys."""
+    path = Path(path)
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return set(data.get("accepted", []))
+
+
+def save_baseline(findings: list[Finding],
+                  path: Path | str = BASELINE_PATH,
+                  merge: set[str] = frozenset()) -> None:
+    """Write the baseline from ``findings`` (plus ``merge``, for partial
+    runs that must not erase other models' accepted keys)."""
+    payload = {
+        "comment": "Accepted analysis findings; regenerate with "
+                   "`python -m tpu_hc_bench.analysis --all "
+                   "--update-baseline`.  The CI gate fails only on "
+                   "findings whose key is NOT listed here.",
+        "accepted": sorted({f.key for f in findings} | set(merge)),
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def compare_to_baseline(findings: list[Finding],
+                        baseline: set[str] | None = None,
+                        ) -> list[Finding]:
+    """The regressions: findings whose key the baseline does not accept.
+
+    Severity "info" findings never gate (they are attribution output,
+    not defects).
+    """
+    if baseline is None:
+        baseline = load_baseline()
+    return [f for f in findings
+            if f.severity in ("error", "warning") and f.key not in baseline]

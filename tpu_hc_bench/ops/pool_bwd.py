@@ -153,40 +153,69 @@ def _pool_fwd(x, window, strides, padding):
     return y, (x, y)
 
 
+def _xla_pool_vjp(x, dy, window, strides, padding, out_dtype):
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        # integer primals have no JAX tangent space (vjp hands back
+        # float0 cotangents): run the select-and-scatter on the f32
+        # image of the values — exact for |v| < 2^24, and max selection
+        # only compares values — and cast the cotangent back
+        dx = _xla_pool_vjp(x.astype(jnp.float32), dy.astype(jnp.float32),
+                           window, strides, padding, jnp.float32)
+        return dx.astype(x.dtype)
+    _, vjp = jax.vjp(
+        lambda v: _pool_fwd_raw(v, window, strides, padding), x)
+    return vjp(dy.astype(out_dtype))[0]
+
+
 def _pool_bwd(window, strides, padding, res, dy):
     x, y = res
     B, H, W, C = x.shape
     Ho, Wo, plh, plw = _pool_dims(x.shape, window, strides, padding)
     ct = _channel_tile(H, W, C, window[0] * window[1])
-    if ct == 0 or window[0] < strides[0] or window[1] < strides[1]:
+    if (ct == 0 or window[0] < strides[0] or window[1] < strides[1]
+            or not jnp.issubdtype(x.dtype, jnp.floating)):
         # shape out of kernel range (stride > window would need negative
-        # high pads — the skipped-input-rows case): XLA's own
-        # select-and-scatter VJP
-        _, vjp = jax.vjp(
-            lambda v: _pool_fwd_raw(v, window, strides, padding), x)
-        return (vjp(dy.astype(y.dtype))[0],)
+        # high pads — the skipped-input-rows case), or a non-float dtype
+        # (the kernel's -inf pad identity has no integer encoding —
+        # jnp.asarray(-inf, int) raises): XLA's own select-and-scatter
+        # VJP, whose pad identity is dtype-aware (_pool_fwd_raw)
+        return (_xla_pool_vjp(x, dy, window, strides, padding, y.dtype),)
     kernel = functools.partial(
         _bwd_kernel, window=window, strides=strides, pads=(plh, plw),
         out_dims=(Ho, Wo))
-    dx = pl.pallas_call(
-        kernel,
-        grid=(B, C // ct),
-        in_specs=[
-            pl.BlockSpec((1, H, W, ct), lambda b, c: (b, 0, 0, c)),
-            pl.BlockSpec((1, Ho, Wo, ct), lambda b, c: (b, 0, 0, c)),
-            pl.BlockSpec((1, Ho, Wo, ct), lambda b, c: (b, 0, 0, c)),
-        ],
-        out_specs=pl.BlockSpec((1, H, W, ct), lambda b, c: (b, 0, 0, c)),
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        # Mosaic's stack accounting for the per-tap pad temporaries runs
-        # ~10x the live set; v5e has 128M physical VMEM and the default
-        # 16M scoped limit is what overflows — raise it instead of
-        # shrinking the lane tile
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel"),
-            vmem_limit_bytes=VMEM_LIMIT_BYTES),
-        interpret=_interpret(),
-    )(x, y, dy.astype(y.dtype))
+    def _kernel_path(operands):
+        x_, y_, dy_ = operands
+        return pl.pallas_call(
+            kernel,
+            grid=(B, C // ct),
+            in_specs=[
+                pl.BlockSpec((1, H, W, ct), lambda b, c: (b, 0, 0, c)),
+                pl.BlockSpec((1, Ho, Wo, ct), lambda b, c: (b, 0, 0, c)),
+                pl.BlockSpec((1, Ho, Wo, ct), lambda b, c: (b, 0, 0, c)),
+            ],
+            out_specs=pl.BlockSpec((1, H, W, ct),
+                                   lambda b, c: (b, 0, 0, c)),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            # Mosaic's stack accounting for the per-tap pad temporaries
+            # runs ~10x the live set; v5e has 128M physical VMEM and the
+            # default 16M scoped limit is what overflows — raise it
+            # instead of shrinking the lane tile
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel"),
+                vmem_limit_bytes=VMEM_LIMIT_BYTES),
+            interpret=_interpret(),
+        )(x_, y_, dy_)
+
+    def _xla_path(operands):
+        x_, _, dy_ = operands
+        return _xla_pool_vjp(x_, dy_, window, strides, padding, y.dtype)
+
+    # an input that itself contains -inf would tie with the kernel's
+    # -inf pad taps (every tied element gets the full cotangent — wrong
+    # where the "tie" is padding): a value-, not shape-, dependent
+    # hazard, so dispatch at runtime on the (rare) -inf scan
+    dx = lax.cond(jnp.isneginf(x).any(), _xla_path, _kernel_path,
+                  (x, y, dy.astype(y.dtype)))
     return (dx,)
 
 

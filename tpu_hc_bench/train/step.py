@@ -279,9 +279,15 @@ def build_train_step(
         tree bf16 through the allreduce and into the optimizer (optax
         promotes against its f32 traces): the accumulator HBM footprint
         AND the gradient wire bytes halve — the lever for param-bound
-        members whose +1x-params f32 tree OOMs (llama_1b, gpt2_moe) — at
-        ~3 significant digits of gradient precision.  Loss and BN stats
-        always accumulate in f32.
+        members whose +1x-params f32 tree OOMs (llama_1b, gpt2_moe).
+        Precision depends on the accumulation count: each microbatch
+        addition quantizes to bf16's ~2^-9 relative step, and the
+        rounding errors random-walk, so the accumulated-gradient error
+        grows ~sqrt(N)*2^-9 — ~3 significant digits at accum=2, but only
+        ~1.5-2 digits (~1-3% relative) at the accum=16-64 configs
+        sweep_zoo.py pins for the large members (pinned by the accum=32
+        arm of tests/test_train.py's bf16-vs-f32 delta tests).  Loss and
+        BN stats always accumulate in f32.
 
         Microbatch semantics (standard accumulation): each microbatch's
         loss is mean-normalized over its own examples/weights, then the
@@ -379,9 +385,9 @@ def build_train_step(
         if new_stats:
             # sync running stats so replicated state stays identical —
             # through the SAME fusion buckets as the gradients (round 5:
-            # the world=2 HLO count showed resnet20's 46 collectives vs
-            # bert's 4 were per-tensor BN-stat pmeans; bucketing them
-            # turns ~42 latency-bound crossings into one)
+            # the world=2 HLO count showed resnet20's 44 collectives vs
+            # bert's 2 were per-tensor BN-stat pmeans; bucketing them
+            # turns 42 latency-bound crossings into one)
             if fuse:
                 new_stats = fused_psum_tree(
                     new_stats, axis_name=axes,
