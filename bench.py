@@ -32,9 +32,13 @@ def main() -> int:
         jax.config.update("jax_num_cpu_devices", 8)
 
     from tpu_hc_bench import flags
+    from tpu_hc_bench.obs import metrics as obs_metrics
     from tpu_hc_bench.train import driver
 
     cfg = flags.BenchmarkConfig(
+        # full obs artifact (metrics.jsonl + manifest.json) when asked;
+        # the manifest fields below ride in the JSON line regardless
+        metrics_dir=os.environ.get("BENCH_METRICS_DIR") or None,
         batch_size=int(os.environ.get("BENCH_BATCH_SIZE", "128")),
         model=os.environ.get("BENCH_MODEL", "resnet50"),
         use_fp16=True,          # bf16 compute: the TPU-native fast path
@@ -58,6 +62,16 @@ def main() -> int:
         cfg, fabric_name="ici",
         print_fn=lambda m: print(m, file=sys.stderr, flush=True),
     )
+    # run-identity manifest (obs.metrics): the answer to "what exactly
+    # produced this BENCH_*.json" — versions, git sha, device, world.
+    # With BENCH_METRICS_DIR set the driver already wrote the manifest;
+    # reuse it so the artifact and the JSON line agree on one record
+    if cfg.metrics_dir:
+        with open(os.path.join(cfg.metrics_dir,
+                               obs_metrics.MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+    else:
+        manifest = obs_metrics.run_manifest(cfg=cfg)
     print(json.dumps({
         "metric": f"{cfg.model}_synthetic_images_per_sec_per_chip",
         "value": round(result.images_per_sec_per_chip, 2),
@@ -71,7 +85,15 @@ def main() -> int:
             "chips": result.total_workers,
             "global_batch": result.global_batch,
             "mean_step_ms": round(result.mean_step_ms, 3),
+            "p50_step_ms": round(result.p50_step_ms, 3),
+            "p50_step_granularity": result.p50_step_granularity,
             "dtype": cfg.compute_dtype,
+        },
+        "manifest": {
+            k: manifest.get(k)
+            for k in ("git_sha", "jax_version", "jaxlib_version",
+                      "platform", "device_kind", "process_count",
+                      "device_count", "created_unix")
         },
     }))
     return 0

@@ -38,10 +38,12 @@ import sys
 sys.path.insert(0, ".")
 sys.path.insert(0, "scripts")
 
-from exp_vit_trace import (classify, device_op_times, run_once,
-                           step_hlo_text, TRACED)
+# program construction/timing stays with the exp harness; ALL perfetto
+# parsing comes from the reusable obs.trace (round 7 promotion)
+from exp_vit_trace import run_once, step_hlo_text, TRACED
 
 from tpu_hc_bench.analysis import hlo
+from tpu_hc_bench.obs.trace import classify, device_op_times
 
 # leaf opcodes that are MXU matmul work (ragged-dot is the ragged arm's
 # grouped expert matmul; plain dot covers einsum dispatch + attention)

@@ -20,10 +20,12 @@ Round 5: generalized from image members to the WHOLE zoo — the synthetic
 batch dispatches on the member's spec flags exactly like the driver
 (tokens / CTC spectrograms / NCF id pairs / images), and
 `--attention_impl` / `--moe_impl` pass through so the text members trace
-at their best-known configs.  Envelope filtering is now NESTING-based
-(an X event that strictly encloses another on its track is a container,
-whatever its name) instead of the old `isdigit()`/`jit_` name heuristic,
-which double-counted any differently-named step marker.
+at their best-known configs.
+
+Round 7: the perfetto parsing (nesting-based envelope filtering with the
+same-tid containment rule, op classification) moved to the reusable
+`tpu_hc_bench.obs.trace` — this script is now a thin consumer: it builds
+and times the traced program; `obs.trace` owns the trace analysis.
 
 Measurement caveats found while building this (recorded in BASELINE.md):
 the axon tunnel's profiler reports device event durations scaled by a
@@ -38,9 +40,6 @@ measured step time is wildly off the recorded zoo table.
 from __future__ import annotations
 
 import argparse
-import glob
-import gzip
-import json
 import sys
 import time
 from collections import defaultdict
@@ -51,6 +50,7 @@ import jax.numpy as jnp
 sys.path.insert(0, ".")
 
 from tpu_hc_bench import flags
+from tpu_hc_bench.obs.trace import classify, device_op_times  # noqa: F401
 from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
 from tpu_hc_bench.models import create_model
 from tpu_hc_bench.train import step as step_mod
@@ -146,110 +146,6 @@ def run_once(model_name: str, batch: int, trace_dir: str,
             state, metrics = train_step(state, dev_batch, rng)
         jax.device_get(metrics["loss"])
     return step_ms
-
-
-def device_op_times(trace_dir: str) -> tuple[dict[str, float],
-                                             dict[str, int]]:
-    """Aggregate device-track op durations (us) + event counts from the
-    perfetto trace.  Counts are raw event counts (all traced steps, all
-    device pids); divide by TRACED for per-step instruction counts —
-    single-chip vit traces show exactly TRACED events per name."""
-    paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
-    if not paths:
-        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
-    with gzip.open(sorted(paths)[-1], "rt") as f:
-        trace = json.load(f)
-    events = trace["traceEvents"]
-    device_pids = {
-        e["pid"] for e in events
-        if e.get("ph") == "M" and e.get("name") == "process_name"
-        and "TPU" in str(e.get("args", {}).get("name", ""))
-    }
-    if not device_pids:
-        # fail as loudly as the missing-trace case: an attribution table
-        # silently built from zero device events reads as "no hot ops"
-        raise RuntimeError(
-            f"trace under {trace_dir} has no TPU device track — "
-            "did the run fall back to CPU?")
-    # Envelope filtering by NESTING (round 5): an X event that encloses
-    # other X events is a container (step marker, jit program envelope,
-    # region) and would double-count its children — attribution wants
-    # leaf ops only.  The old name heuristic (`isdigit()` / `jit_`
-    # prefix) silently counted any differently-named container as a
-    # leaf.  Round 6 (ADVICE r5): containment is tested WITHIN one
-    # (pid, tid) track only — a genuinely long leaf op on one track
-    # merely *overlapping* >= 2 short ops on a sibling track (e.g. a
-    # concurrent DMA/stream track) is real device time, not a container,
-    # and the old cross-tid test silently dropped it.  Containers that
-    # matter for double-counting are the ones sharing a track with their
-    # children; an envelope living alone on its own track contains
-    # nothing on that track and is kept — which only inflates the count
-    # of tracks that carry no leaf ops at all, a far smaller error than
-    # dropping measured leaf time.  The >= 2 threshold keeps
-    # identical-interval op pairs, which "contain" each other once.
-    by_track: dict[tuple, list] = defaultdict(list)
-    for e in events:
-        if (e.get("ph") == "X" and e.get("pid") in device_pids
-                and e.get("dur", 0) > 0):
-            by_track[(e["pid"], e.get("tid", 0))].append(e)
-    ops: dict[str, float] = defaultdict(float)
-    counts: dict[str, int] = defaultdict(int)
-    for evs in by_track.values():
-        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
-        n = len(evs)
-        for i, e in enumerate(evs):
-            end = e["ts"] + e["dur"]
-            contained = 0
-            # events are start-sorted: scan candidates starting inside
-            # [ts, end) — leaves exit immediately, containers after 2
-            j = i + 1
-            while j < n and evs[j]["ts"] < end and contained < 2:
-                if evs[j]["ts"] + evs[j].get("dur", 0) <= end:
-                    contained += 1
-                j += 1
-            if contained >= 2:
-                continue
-            ops[e["name"]] += e["dur"]
-            counts[e["name"]] += 1
-    return dict(ops), dict(counts)
-
-
-def classify(name: str) -> str:
-    n = name.lower()
-    # order matters — later checks use substrings the earlier classes
-    # also contain:
-    #   collectives first ("all-reduce" would otherwise hit "reduce");
-    #   reductions before conv ("convert_reduce_fusion" contains "conv"
-    #   but its work is the reduction, the cast is fused in);
-    #   casts/relayouts before conv ("bitcast_convert"/"convert" contain
-    #   "conv" but move/cast bytes, no MXU work)
-    if any(k in n for k in ("all-reduce", "allreduce", "all-gather",
-                            "allgather", "reduce-scatter", "all-to-all",
-                            "collective", "permute", "psum")):
-        return "collective"
-    if any(k in n for k in ("reduce", "norm", "softmax")):
-        return "reduce/norm"
-    # select-and-scatter is max-pool BACKWARD (a windowed reduction, not
-    # routing) — must be caught before the gather/sort class below would
-    # claim its "scatter" substring
-    if "select-and-scatter" in n:
-        return "pool-bwd"
-    # routing/permutation work (MoE dispatch, embedding lookups): sorts,
-    # gathers, scatters — split out from elementwise/other so the ragged
-    # MoE and ncf attributions can see it (plain "gather" lands here;
-    # "all-gather" was already caught by the collective class above)
-    if any(k in n for k in ("sort", "gather", "scatter", "cumsum", "iota")):
-        return "gather/sort"
-    if any(k in n for k in ("copy", "transpose", "reshape", "bitcast",
-                            "convert", "concatenate", "slice", "pad")):
-        return "data-movement"
-    if "conv" in n:
-        return "conv"
-    if "dot" in n or "matmul" in n or "einsum" in n:
-        return "matmul"
-    if any(k in n for k in ("infeed", "outfeed", "barrier", "sync")):
-        return "infra"
-    return "elementwise/other"
 
 
 def main(argv=None) -> int:

@@ -52,7 +52,8 @@ def free_port() -> int:
 
 def run_config(world: int, fabric: str, model: str, batch: int,
                total_devices: int, warmup: int, batches: int,
-               workdir: Path, timeout: int = 2400) -> dict:
+               workdir: Path, timeout: int = 2400,
+               metrics_dir: Path | None = None) -> dict:
     """One table cell: WORLD processes through the literal CLI."""
     devices_per = total_devices // world
     assert devices_per * world == total_devices
@@ -60,6 +61,11 @@ def run_config(world: int, fabric: str, model: str, batch: int,
            str(world), "0", str(batch), fabric,
            f"--model={model}", f"--num_warmup_batches={warmup}",
            f"--num_batches={batches}", f"--virtual_devices={devices_per}"]
+    if metrics_dir is not None:
+        # per-cell obs artifact: rank 0 writes metrics.jsonl + manifest
+        # there, so each world size leaves a diffable record
+        # (python -m tpu_hc_bench.obs diff <cell_a> <cell_b>)
+        cmd.append(f"--metrics_dir={metrics_dir}")
     hostfile = workdir / f"nodeips_{world}.txt"
     hostfile.write_text("127.0.0.1\n" * world)
     port = free_port()
@@ -112,6 +118,7 @@ def run_config(world: int, fabric: str, model: str, batch: int,
         "warmup": warmup, "batches": batches,
         "total_ex_per_sec": float(m.group(1)),
         "mean_step_ms": float(s.group(1)) if s else None,
+        "metrics_dir": str(metrics_dir) if metrics_dir else None,
     }
 
 
@@ -127,6 +134,9 @@ def main(argv=None) -> int:
     ap.add_argument("--batches", type=int, default=100)
     ap.add_argument("--out", default="artifacts/scaling_r04")
     ap.add_argument("--timeout", type=int, default=2400)
+    ap.add_argument("--no-metrics", dest="metrics", action="store_false",
+                    default=True,
+                    help="skip the per-cell obs.metrics artifacts")
     args = ap.parse_args(argv)
 
     worlds = [int(w) for w in args.worlds.split(",")]
@@ -142,10 +152,14 @@ def main(argv=None) -> int:
             for fabric in fabrics:
                 for world in worlds:
                     t0 = time.time()
+                    cell_metrics = (
+                        out_dir / "obs" / f"w{world}_{fabric}_{model}"
+                        if args.metrics else None)
                     row = run_config(world, fabric, model, args.batch,
                                      args.total_devices, args.warmup,
                                      args.batches, out_dir,
-                                     timeout=args.timeout)
+                                     timeout=args.timeout,
+                                     metrics_dir=cell_metrics)
                     row["wall_s"] = round(time.time() - t0, 1)
                     rows.append(row)
                     f.write(json.dumps(row) + "\n")

@@ -40,3 +40,12 @@ def test_scaling_harness_two_process_cell(tmp_path):
     assert row["total_ex_per_sec"] > 0
     table = (out_dir / "scaling.md").read_text()
     assert "| lenet | ici | 2 |" in table
+    # round 7: every cell leaves an obs.metrics artifact — rank 0 of the
+    # REAL 2-process run wrote the merged record (worker-0-writes rule)
+    cell = out_dir / "obs" / "w2_ici_lenet"
+    assert row["metrics_dir"] == str(cell)
+    manifest = json.loads((cell / "manifest.json").read_text())
+    assert manifest["process_count"] == 2
+    records = [json.loads(l) for l in
+               (cell / "metrics.jsonl").read_text().splitlines()]
+    assert records and records[-1]["kind"] == "summary"

@@ -27,6 +27,7 @@ import io
 import os
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Iterator
@@ -194,6 +195,12 @@ class ImageNetDataset:
         if not decode_workers:
             decode_workers = max(1, min(32, (os.cpu_count() or 2) - 1))
         self.decode_workers = decode_workers
+        # decode-pool counters (obs.metrics "data" record): written by the
+        # producer thread, read by the driver after the run — scalar
+        # updates under the GIL, no lock needed
+        self._batches_decoded = 0
+        self._examples_decoded = 0
+        self._decode_wall_s = 0.0
 
     @staticmethod
     def _read_shard(path: str) -> Iterator[bytes]:
@@ -243,6 +250,7 @@ class ImageNetDataset:
         stream_idx = 0
         try:
             while True:
+                t0 = time.perf_counter()
                 images = np.empty((self.global_batch, s, s, 3), dtype)
                 labels = np.empty((self.global_batch,), np.int32)
                 items = []
@@ -258,10 +266,28 @@ class ImageNetDataset:
                             for it in items]
                     for f in futs:
                         f.result()   # re-raises decode errors here
+                self._batches_decoded += 1
+                self._examples_decoded += self.global_batch
+                self._decode_wall_s += time.perf_counter() - t0
                 yield images, labels
         finally:
             if pool is not None:
                 pool.shutdown(wait=False, cancel_futures=True)
+
+    def stats(self) -> dict:
+        """Decode-pool counters for the run's metrics artifact.
+
+        ``decode_wall_s`` is the producer thread's wall time building
+        batches (shard read + parse + parallel JPEG decode) — it
+        overlaps the device step via the prefetch queue, so it bounds
+        the host-side input rate rather than adding to step time.
+        """
+        return {
+            "batches": self._batches_decoded,
+            "examples": self._examples_decoded,
+            "decode_wall_s": round(self._decode_wall_s, 3),
+            "decode_workers": self.decode_workers,
+        }
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         """Prefetching iterator: decode runs in a daemon thread."""

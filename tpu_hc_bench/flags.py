@@ -54,6 +54,28 @@ DEFAULT_FUSION_THRESHOLD_BYTES = 134217728
 SEQ_SHARDED_IMPLS = ("ring", "ulysses", "ulysses_flash")
 
 
+def parse_profile_steps(spec: str) -> tuple[int, int]:
+    """Parse ``--profile_steps=a:b`` into an inclusive timed-step window.
+
+    Loud on malformed input (resolve() calls this so a bad window dies at
+    flag time, not after 50 warmup steps).  ``b`` may exceed the run
+    length — the trace then simply stops when the run does.
+    """
+    parts = spec.split(":")
+    try:
+        if len(parts) != 2:
+            raise ValueError
+        a, b = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"--profile_steps must be 'a:b' (1-based timed-step bounds, "
+            f"inclusive): {spec!r}") from None
+    if a < 1 or b < a:
+        raise ValueError(
+            f"--profile_steps window must satisfy 1 <= a <= b: {spec!r}")
+    return a, b
+
+
 def _parse_bool(v: str | bool) -> bool:
     """tf_cnn_benchmarks accepts TRUE/False/true/... for boolean flags."""
     if isinstance(v, bool):
@@ -142,6 +164,15 @@ class BenchmarkConfig:
     trace_dir: str | None = None              # jax.profiler trace output; the
                                               # structured upgrade of the
                                               # reference's I_MPI_DEBUG tracing
+    profile_steps: str | None = None          # "a:b": profile timed steps
+                                              # a..b into --trace_dir (window
+                                              # bounds observed via the
+                                              # timeline's completion markers);
+                                              # unset = the legacy first-
+                                              # sync-window trace
+    metrics_dir: str | None = None            # per-run observability artifact:
+                                              # metrics.jsonl + manifest.json
+                                              # (obs.metrics; worker 0 writes)
     num_slices: int = 0                       # fabric=dcn multislice layout:
                                               # slices x hosts/slice x chips
                                               # (0 = one slice per host)
@@ -283,6 +314,20 @@ class BenchmarkConfig:
             raise ValueError(f"--num_epochs must be >= 0: {self.num_epochs}")
         if self.num_batches is None and not self.num_epochs:
             self.num_batches = DEFAULT_NUM_BATCHES
+        if self.profile_steps is not None:
+            if not self.trace_dir:
+                raise ValueError(
+                    "--profile_steps selects WHICH timed steps to profile; "
+                    "--trace_dir says where the trace goes — set both")
+            if self.eval:
+                # same loud-error principle as the other eval exclusions:
+                # the window is defined over the timed TRAINING steps, and
+                # accepting the flag under --eval would silently write no
+                # trace
+                raise ValueError(
+                    "--profile_steps applies to the timed training loop; "
+                    "it has no meaning under --eval")
+            parse_profile_steps(self.profile_steps)  # loud format check
         if self.model_parallel > 1 and self.expert_parallel > 1:
             raise ValueError(
                 "--model_parallel and --expert_parallel are exclusive: both "
@@ -538,6 +583,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--trace_dir", type=str, default=None)
+    p.add_argument("--profile_steps", type=str, default=None,
+                   metavar="A:B")
+    p.add_argument("--metrics_dir", type=str, default=None)
     p.add_argument("--num_slices", type=int, default=d.num_slices)
     p.add_argument("--fused_conv", type=_parse_bool, default=d.fused_conv)
     p.add_argument("--fused_xent", type=_parse_bool, default=False)

@@ -1,0 +1,24 @@
+"""Runtime observability: trace analysis, run metrics, summarize/diff.
+
+The runtime counterpart of the static ``tpu_hc_bench.analysis`` package.
+Where ``analysis`` inspects the *compiled program* (HLO, jaxpr),
+``obs`` inspects *runs*:
+
+- ``obs.trace`` — reusable perfetto-trace analysis promoted out of the
+  one-off experiment scripts (``scripts/exp_vit_trace.py``,
+  ``scripts/exp_moe_trace_r05.py``): leaf-op extraction with the
+  same-tid containment rule, op classification, per-step timeline
+  reconstruction, and compute/collective/host-transfer/idle-bubble
+  bucket attribution.
+- ``obs.metrics`` — the per-run artifact: a ``metrics.jsonl`` stream of
+  windowed measurements plus a ``manifest.json`` (resolved flags, mesh
+  shape, world size, versions, git sha) written next to it, so every
+  benchmark run leaves something machine-readable behind.
+- ``python -m tpu_hc_bench.obs`` — ``summarize`` renders either
+  artifact kind (a metrics run or a raw trace directory);
+  ``diff`` compares two runs at bucket/metric granularity, so a
+  regression reads "collective +40%, compute flat" instead of a single
+  throughput delta.
+"""
+
+from tpu_hc_bench.obs import metrics, trace  # noqa: F401

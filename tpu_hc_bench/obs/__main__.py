@@ -1,0 +1,95 @@
+"""CLI: ``python -m tpu_hc_bench.obs`` — summarize / diff run artifacts.
+
+Examples::
+
+    # render a metrics run (dir with metrics.jsonl + manifest.json)
+    python -m tpu_hc_bench.obs summarize /runs/r50_bs128
+
+    # render a raw jax.profiler trace directory
+    python -m tpu_hc_bench.obs summarize /tmp/vit_trace_vit_b16_64
+
+    # bucket-level regression view between two runs:
+    # "collective +40%, compute flat" instead of one throughput delta
+    python -m tpu_hc_bench.obs diff /runs/before /runs/after
+
+Both subcommands are pure file operations — no jax backend is touched,
+so artifacts copied off a TPU VM diff fine on a laptop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+from tpu_hc_bench.obs import metrics as metrics_mod
+from tpu_hc_bench.obs import trace as trace_mod
+
+
+def _kind(path: str) -> str:
+    """Autodetect an artifact path: 'metrics' run or raw 'trace' dir."""
+    if os.path.isfile(path):
+        # direct files: a perfetto trace (compressed or gunzipped for
+        # inspection — load_events handles both) vs a metrics jsonl
+        name = os.path.basename(path)
+        return "trace" if (name.endswith(".gz")
+                           or ".trace.json" in name) else "metrics"
+    if os.path.isfile(os.path.join(path, metrics_mod.METRICS_NAME)):
+        return "metrics"
+    if glob.glob(f"{path}/**/*.trace.json.gz", recursive=True):
+        return "trace"
+    raise FileNotFoundError(
+        f"{path}: neither a metrics run (no {metrics_mod.METRICS_NAME}) "
+        "nor a trace dir (no *.trace.json.gz)")
+
+
+def _summarize(path: str, out) -> int:
+    if _kind(path) == "metrics":
+        lines = metrics_mod.summarize_run(path)
+    else:
+        summary = trace_mod.summarize_trace_dir(path)
+        lines = trace_mod.format_summary(summary, title=f"trace {path}")
+    print("\n".join(lines), file=out)
+    return 0
+
+
+def _diff(path_a: str, path_b: str, out) -> int:
+    kind_a, kind_b = _kind(path_a), _kind(path_b)
+    if kind_a != kind_b:
+        print(f"cannot diff a {kind_a} run against a {kind_b} run",
+              file=sys.stderr)
+        return 2
+    if kind_a == "metrics":
+        lines = metrics_mod.diff_runs(path_a, path_b)
+    else:
+        a = trace_mod.summarize_trace_dir(path_a)
+        b = trace_mod.summarize_trace_dir(path_b)
+        lines = [f"trace diff: {path_a} -> {path_b}"]
+        lines.extend(trace_mod.diff_buckets(a.totals, b.totals))
+    print("\n".join(lines), file=out)
+    return 0
+
+
+def main(argv: list[str] | None = None, out=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_hc_bench.obs",
+        description="summarize/diff benchmark-run artifacts "
+                    "(metrics runs or jax.profiler trace dirs)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("summarize",
+                       help="render one run (metrics dir/jsonl or trace dir)")
+    s.add_argument("path")
+    d = sub.add_parser("diff",
+                       help="per-bucket/per-metric deltas between two runs")
+    d.add_argument("run_a")
+    d.add_argument("run_b")
+    args = ap.parse_args(argv)
+    out = out or sys.stdout
+    if args.cmd == "summarize":
+        return _summarize(args.path, out)
+    return _diff(args.run_a, args.run_b, out)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

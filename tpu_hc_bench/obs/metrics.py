@@ -1,0 +1,350 @@
+"""Per-run machine-readable artifacts: ``metrics.jsonl`` + ``manifest.json``.
+
+The reference harness's only observable output is the printed
+``images/sec`` lines an operator greps from a teed log (SURVEY.md §5);
+until this module, our driver inherited that.  A run with
+``--metrics_dir`` now leaves two files behind:
+
+- ``manifest.json`` — run identity: the resolved flag set, mesh shape,
+  world size, jax/jaxlib versions, git sha, device kind.  Everything a
+  regression hunt needs to answer "what exactly was this run?".
+- ``metrics.jsonl`` — one record per event, ``kind``-tagged:
+  ``window`` (per-display-window rate/step-time/loss), ``memory``
+  (``device.memory_stats()`` peak/live bytes, where the backend
+  supports it), ``data`` (host decode-pool counters on real-data runs),
+  ``trace_buckets`` (the post-run trace attribution when profiling ran),
+  and a final ``summary`` (the BenchmarkResult fields).
+
+Multi-process runs write from process 0 only: the driver's metrics are
+already globally aggregated (the loss is psum'd across the mesh, rates
+are computed from the global batch — the ``utils/sync`` timeline
+observes global step completion), so worker 0's view IS the merged
+record and the writer no-ops elsewhere.
+
+``read_run`` / ``summarize_run`` / ``diff_runs`` are pure file
+operations (no jax backend touch) so the CLI works on artifacts from
+any machine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any
+
+SCHEMA_VERSION = 1
+
+METRICS_NAME = "metrics.jsonl"
+MANIFEST_NAME = "manifest.json"
+
+
+# ---------------------------------------------------------------------
+# manifest
+
+
+def _git_sha() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return "unknown"
+
+
+def run_manifest(cfg: Any = None, layout: Any = None, mesh: Any = None,
+                 fabric: str | None = None,
+                 extra: dict | None = None) -> dict:
+    """Assemble the run manifest.  Needs a live jax backend (versions,
+    world size); everything is best-effort so a manifest never kills a
+    benchmark run."""
+    import jax
+
+    m: dict[str, Any] = {
+        "schema": SCHEMA_VERSION,
+        "created_unix": time.time(),
+        "argv": list(sys.argv),
+        "git_sha": _git_sha(),
+        "jax_version": jax.__version__,
+    }
+    try:
+        import jaxlib
+
+        m["jaxlib_version"] = jaxlib.__version__
+    except Exception:
+        m["jaxlib_version"] = "unknown"
+    try:
+        m["process_count"] = jax.process_count()
+        m["device_count"] = jax.device_count()
+        m["platform"] = jax.devices()[0].platform
+        m["device_kind"] = jax.devices()[0].device_kind
+    except Exception:
+        pass
+    if cfg is not None:
+        d = dataclasses.asdict(cfg)
+        m["config"] = {k: v for k, v in d.items() if k != "translations"}
+        m["translations"] = d.get("translations", {})
+        m["model"] = getattr(cfg, "model", None)
+    if layout is not None:
+        m["num_hosts"] = getattr(layout, "num_hosts", None)
+        m["total_workers"] = getattr(layout, "total_workers", None)
+    if mesh is not None:
+        try:
+            m["mesh_shape"] = {str(k): int(v)
+                               for k, v in dict(mesh.shape).items()}
+        except Exception:
+            m["mesh_shape"] = None
+    if fabric is not None:
+        m["fabric"] = fabric
+    if extra:
+        m.update(extra)
+    return m
+
+
+def device_memory_stats() -> dict:
+    """Peak/live HBM bytes per local device, where the backend exposes
+    them (TPU does; the CPU test mesh returns nothing)."""
+    import jax
+
+    out: dict[str, Any] = {}
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            out[f"d{d.id}"] = {
+                k: stats[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                      "bytes_limit") if k in stats
+            }
+    except Exception:
+        return {}
+    return out
+
+
+# ---------------------------------------------------------------------
+# writer
+
+
+class MetricsWriter:
+    """Append-only JSONL stream + manifest for one run.
+
+    Disabled (every method a no-op) when ``out_dir`` is falsy or this is
+    not process 0 — call sites never branch.  The manifest is written
+    eagerly at construction so even a crashed run identifies itself.
+    """
+
+    def __init__(self, out_dir: str | None, manifest: dict | None = None,
+                 primary: bool | None = None):
+        self._f = None
+        if not out_dir:
+            return
+        if primary is None:
+            import jax
+
+            primary = jax.process_index() == 0
+        if not primary:
+            return
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        if manifest is not None:
+            with open(os.path.join(out_dir, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f, indent=2, default=str)
+                f.write("\n")
+        self._f = open(os.path.join(out_dir, METRICS_NAME), "w")
+
+    @property
+    def enabled(self) -> bool:
+        return self._f is not None
+
+    def event(self, kind: str, **fields) -> None:
+        if self._f is None:
+            return
+        rec = {"kind": kind}
+        rec.update(fields)
+        self._f.write(json.dumps(rec, default=str) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+# ---------------------------------------------------------------------
+# reading / summarize / diff (pure file ops — no jax)
+
+
+def resolve_run(path: str) -> tuple[str | None, str]:
+    """Resolve a run path to ``(manifest_path_or_None, metrics_path)``.
+
+    Accepts the metrics directory or the ``metrics.jsonl`` file itself;
+    the manifest is looked up next to the stream.
+    """
+    if os.path.isdir(path):
+        metrics = os.path.join(path, METRICS_NAME)
+    else:
+        metrics = path
+    if not os.path.isfile(metrics):
+        raise FileNotFoundError(f"no {METRICS_NAME} at {path}")
+    manifest = os.path.join(os.path.dirname(metrics), MANIFEST_NAME)
+    return (manifest if os.path.isfile(manifest) else None), metrics
+
+
+def read_run(path: str) -> tuple[dict, list[dict]]:
+    """Load ``(manifest, records)`` for a run (manifest {} if absent)."""
+    manifest_path, metrics_path = resolve_run(path)
+    manifest = {}
+    if manifest_path:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    records = []
+    with open(metrics_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return manifest, records
+
+
+def _of_kind(records: list[dict], kind: str) -> list[dict]:
+    return [r for r in records if r.get("kind") == kind]
+
+
+def _last(records: list[dict], kind: str) -> dict | None:
+    recs = _of_kind(records, kind)
+    return recs[-1] if recs else None
+
+
+def summarize_run(path: str) -> list[str]:
+    """Render one metrics run as text lines."""
+    manifest, records = read_run(path)
+    lines = [f"run: {path}"]
+    if manifest:
+        mesh = manifest.get("mesh_shape")
+        lines.append(
+            f"  model={manifest.get('model')} "
+            f"fabric={manifest.get('fabric')} "
+            f"world={manifest.get('process_count')}proc/"
+            f"{manifest.get('device_count')}dev "
+            f"mesh={mesh if mesh else '?'}")
+        lines.append(
+            f"  jax={manifest.get('jax_version')} "
+            f"jaxlib={manifest.get('jaxlib_version')} "
+            f"git={str(manifest.get('git_sha', '?'))[:12]} "
+            f"platform={manifest.get('platform')}")
+    windows = _of_kind(records, "window")
+    if windows:
+        lines.append(f"  {'step':>6s} {'ex/sec':>10s} {'step_ms':>9s} "
+                     f"{'loss':>8s}")
+        for w in windows:
+            lines.append(
+                f"  {w.get('step', '?'):>6} {w.get('rate', 0.0):10.1f} "
+                f"{w.get('step_ms', 0.0):9.2f} {w.get('loss', 0.0):8.3f}")
+    summary = _last(records, "summary")
+    if summary:
+        lines.append(
+            f"  total: {summary.get('total_images_per_sec', 0.0):.2f} "
+            f"ex/s  mean {summary.get('mean_step_ms', 0.0):.2f}ms  "
+            f"p50 {summary.get('p50_step_ms', 0.0):.2f}ms"
+            f" (granularity {summary.get('p50_step_granularity', '?')} "
+            f"step)  MFU {100 * summary.get('mfu', 0.0):.1f}%")
+    data = _last(records, "data")
+    if data:
+        lines.append(
+            f"  data: {data.get('examples', 0)} examples decoded, "
+            f"{data.get('decode_workers', '?')} workers, "
+            f"{data.get('decode_wall_s', 0.0):.1f}s decode wall")
+    mem = _last(records, "memory")
+    if mem and mem.get("devices"):
+        peaks = [v.get("peak_bytes_in_use", 0)
+                 for v in mem["devices"].values()]
+        lines.append(f"  memory: peak {max(peaks) / 2**20:.1f} MiB/device "
+                     f"({len(peaks)} device(s))")
+    tb = _last(records, "trace_buckets")
+    if tb and tb.get("buckets"):
+        total = sum(tb["buckets"].values()) or 1.0
+        parts = ", ".join(f"{k} {v / total:.1%}"
+                          for k, v in sorted(tb["buckets"].items(),
+                                             key=lambda kv: -kv[1]))
+        lines.append(f"  trace buckets: {parts}")
+    return lines
+
+
+def _pct(a: float, b: float) -> str:
+    if a:
+        return f"{(b - a) / a:+.1%}"
+    return "new" if b else "-"
+
+
+def diff_runs(path_a: str, path_b: str) -> list[str]:
+    """Compare two metrics runs: headline metrics, per-bucket trace
+    deltas, and any resolved-flag differences."""
+    from tpu_hc_bench.obs import trace as trace_mod
+
+    man_a, recs_a = read_run(path_a)
+    man_b, recs_b = read_run(path_b)
+    lines = [f"diff: {path_a} -> {path_b}"]
+
+    # resolved-flag drift: a perf delta with a config delta is not a
+    # regression, it is a different experiment — say so first.  For
+    # output-LOCATION flags only presence matters: two clean A/B runs
+    # necessarily write to different paths (noise on every diff), but
+    # set-vs-unset IS behavioral drift (checkpoint saves sync the
+    # device, profiling perturbs the window)
+    path_flags = {"metrics_dir", "trace_dir", "train_dir"}
+    cfg_a, cfg_b = man_a.get("config", {}), man_b.get("config", {})
+
+    def _cmp(cfg, k):
+        v = cfg.get(k)
+        return (v is not None) if k in path_flags else v
+
+    changed = {k for k in set(cfg_a) | set(cfg_b)
+               if _cmp(cfg_a, k) != _cmp(cfg_b, k)}
+    for k in sorted(changed):
+        lines.append(f"  config: {k}: {cfg_a.get(k)!r} -> {cfg_b.get(k)!r}")
+    for k in ("jax_version", "jaxlib_version", "git_sha", "device_kind",
+              "process_count", "device_count"):
+        if man_a.get(k) != man_b.get(k):
+            lines.append(f"  env: {k}: {man_a.get(k)} -> {man_b.get(k)}")
+
+    sum_a = _last(recs_a, "summary") or {}
+    sum_b = _last(recs_b, "summary") or {}
+    metrics = (
+        ("total ex/s", "total_images_per_sec"),
+        ("ex/s/chip", "images_per_sec_per_chip"),
+        ("mean step ms", "mean_step_ms"),
+        ("p50 step ms", "p50_step_ms"),
+        ("mfu", "mfu"),
+        ("final loss", "final_loss"),
+    )
+    lines.append(f"  {'metric':>14s} {'a':>12s} {'b':>12s} {'delta':>8s}")
+    for label, key in metrics:
+        if key not in sum_a and key not in sum_b:
+            continue
+        va, vb = sum_a.get(key, 0.0), sum_b.get(key, 0.0)
+        lines.append(f"  {label:>14s} {va:12.4g} {vb:12.4g} "
+                     f"{_pct(va, vb):>8s}")
+
+    tb_a = _last(recs_a, "trace_buckets")
+    tb_b = _last(recs_b, "trace_buckets")
+    if tb_a and tb_b and tb_a.get("buckets") and tb_b.get("buckets"):
+        lines.append("  trace buckets (device us):")
+        lines.extend("  " + ln for ln in trace_mod.diff_buckets(
+            tb_a["buckets"], tb_b["buckets"], label_a="a", label_b="b"))
+    mem_a, mem_b = _last(recs_a, "memory"), _last(recs_b, "memory")
+    if mem_a and mem_b and mem_a.get("devices") and mem_b.get("devices"):
+        pa = max(v.get("peak_bytes_in_use", 0)
+                 for v in mem_a["devices"].values())
+        pb = max(v.get("peak_bytes_in_use", 0)
+                 for v in mem_b["devices"].values())
+        lines.append(f"  {'peak HBM MiB':>14s} {pa / 2**20:12.1f} "
+                     f"{pb / 2**20:12.1f} {_pct(pa, pb):>8s}")
+    return lines
