@@ -79,3 +79,22 @@ def test_summary_lines_cover_config():
     cfg = flags.parse_flags(REFERENCE_ARGV)
     text = "\n".join(cfg.summary_lines())
     assert "resnet50" in text and "momentum" in text and "translated:" in text
+
+
+def test_resilience_flags_parse():
+    cfg = flags.parse_flags([
+        "--on_nonfinite", "skip", "--max_bad_steps", "3",
+        "--resume", "auto", "--step_timeout_s", "auto",
+        "--keep_checkpoints", "5",
+        "--inject_fault", "nan_loss@40,hang@80:30,sigterm@120,io_error@ckpt",
+    ])
+    assert cfg.on_nonfinite == "skip"
+    assert cfg.max_bad_steps == 3
+    assert cfg.step_timeout_s == "auto"
+    assert cfg.keep_checkpoints == 5
+    assert "sigterm@120" in cfg.inject_fault
+    # defaults: resilience machinery entirely off / abort-loudly
+    d = flags.parse_flags([])
+    assert d.on_nonfinite == "abort" and d.resume == "auto"
+    assert d.step_timeout_s is None and d.keep_checkpoints == 0
+    assert d.inject_fault is None

@@ -268,6 +268,37 @@ class BenchmarkConfig:
                                               # steps analog of tf_cnn's
                                               # --save_model_secs)
 
+    # --- resilience (round 8; no reference analog — SURVEY.md §5 notes
+    # the reference just dies) ---
+    on_nonfinite: str = "abort"               # non-finite loss/grad-norm
+                                              # policy: abort (fail the run
+                                              # loudly) | skip (drop the
+                                              # update in-step, donation-
+                                              # safe) | rewind (restore the
+                                              # last checkpoint + skip a
+                                              # window of batches)
+    max_bad_steps: int = 10                   # consecutive-failure budget
+                                              # for skip/rewind: a poisoned
+                                              # run still terminates
+    resume: str = "auto"                      # --train_dir restore policy:
+                                              # auto (restore latest
+                                              # complete checkpoint if any)
+                                              # | never (fresh init) | must
+                                              # (error if none — crash-loop
+                                              # relaunches shouldn't
+                                              # silently restart from step 0)
+    step_timeout_s: str | None = None         # hung-step watchdog: seconds,
+                                              # "auto" (k x warmup mean step
+                                              # time), unset/off = disabled
+    keep_checkpoints: int = 0                 # retention GC: keep only the
+                                              # newest N complete
+                                              # checkpoints (0 = keep all)
+    inject_fault: str | None = None           # deterministic fault
+                                              # injection, e.g. nan_loss@40,
+                                              # hang@80:30,sigterm@120,
+                                              # io_error@ckpt
+                                              # (resilience/inject.py)
+
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
 
@@ -432,6 +463,51 @@ class BenchmarkConfig:
                 t["variable_update"] = (f"{prior}; {note2}" if prior
                                         else note2)
                 self.variable_update = "psum"
+        # --- resilience flag surface (round 8): every invalid combination
+        # dies at flag time, not 50 warmup steps in ---
+        if self.on_nonfinite not in ("abort", "skip", "rewind"):
+            raise ValueError(
+                f"--on_nonfinite must be abort|skip|rewind: "
+                f"{self.on_nonfinite!r}")
+        if self.on_nonfinite in ("skip", "rewind"):
+            if self.forward_only or self.eval:
+                raise ValueError(
+                    "--on_nonfinite=skip/rewind guards the optimizer "
+                    "update; forward-only/--eval runs have none (abort "
+                    "still applies)")
+            if self.pipeline_parallel > 1:
+                raise ValueError(
+                    "--on_nonfinite=skip/rewind is not supported on the "
+                    "GPipe arm yet (the PP step owns its own update "
+                    "loop); supported: DP / TP / EP / SP / multislice")
+        if self.on_nonfinite == "rewind" and not self.train_dir:
+            raise ValueError(
+                "--on_nonfinite=rewind restores the last checkpoint — "
+                "set --train_dir")
+        if self.on_nonfinite == "rewind" and self.resume == "never":
+            raise ValueError(
+                "--on_nonfinite=rewind restores from --train_dir; "
+                "--resume=never contradicts that (a rewind could "
+                "resurrect the very checkpoints you asked to ignore)")
+        if self.max_bad_steps < 1:
+            raise ValueError(
+                f"--max_bad_steps must be >= 1: {self.max_bad_steps}")
+        if self.resume not in ("auto", "never", "must"):
+            raise ValueError(
+                f"--resume must be auto|never|must: {self.resume!r}")
+        if self.resume == "must" and not self.train_dir:
+            raise ValueError("--resume=must needs --train_dir")
+        if self.keep_checkpoints < 0:
+            raise ValueError(
+                f"--keep_checkpoints must be >= 0: {self.keep_checkpoints}")
+        if self.step_timeout_s is not None:
+            from tpu_hc_bench.resilience.watchdog import resolve_timeout
+
+            resolve_timeout(self.step_timeout_s)    # loud format check
+        if self.inject_fault:
+            from tpu_hc_bench.resilience.inject import parse_plan
+
+            parse_plan(self.inject_fault)           # loud format check
         if self.moe_impl == "auto":
             from tpu_hc_bench.models import get_model_spec
 
@@ -576,6 +652,16 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.datasets_repeat_cached_sample)
     p.add_argument("--train_dir", type=str, default=None)
     p.add_argument("--save_model_steps", type=int, default=d.save_model_steps)
+    p.add_argument("--on_nonfinite", type=str, default=d.on_nonfinite,
+                   choices=["abort", "skip", "rewind"])
+    p.add_argument("--max_bad_steps", type=int, default=d.max_bad_steps)
+    p.add_argument("--resume", type=str, default=d.resume,
+                   choices=["auto", "never", "must"])
+    p.add_argument("--step_timeout_s", type=str, default=d.step_timeout_s)
+    p.add_argument("--keep_checkpoints", type=int,
+                   default=d.keep_checkpoints)
+    p.add_argument("--inject_fault", type=str, default=d.inject_fault,
+                   metavar="CLASS@STEP[,...]")
     p.add_argument("--moe_capacity_factor", type=float,
                    default=d.moe_capacity_factor)
     p.add_argument("--fusion_threshold_bytes", type=int,

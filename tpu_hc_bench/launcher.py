@@ -13,6 +13,19 @@ defaults the reference hardcodes (model, warmup, batches...).  Where mpirun
 fanned ranks out over the hostfile (:99-109), here every TPU-VM host runs
 this same command and ``jax.distributed`` coordinates (SPMD launch model);
 on a single host it just runs.
+
+Exit-code contract (``tpu_hc_bench.resilience``; README "Fault
+tolerance" table) — distinct codes so schedulers/wrappers can react
+without parsing logs:
+
+- ``0``  clean success (nonzero throughput measured)
+- ``1``  run completed but measured zero throughput
+- ``70`` watchdog abort — no step completed within ``--step_timeout_s``
+  (thread stacks were dumped to stderr; the process self-terminates
+  with this code from the watchdog thread)
+- ``75`` preempted — SIGTERM/SIGINT honored, emergency checkpoint
+  written when ``--train_dir`` is set; relaunch with ``--resume=auto``
+  to continue
 """
 
 from __future__ import annotations
@@ -20,7 +33,7 @@ from __future__ import annotations
 import sys
 from pathlib import Path
 
-from tpu_hc_bench import envfile, flags
+from tpu_hc_bench import envfile, flags, resilience
 from tpu_hc_bench.parallel import distributed, fabric as fabric_mod
 from tpu_hc_bench.topology import discover_layout
 from tpu_hc_bench.train import driver
@@ -98,16 +111,28 @@ def main(argv: list[str] | None = None) -> int:
 
     # full-command echo, as the reference does at :111
     tee(f"command: python -m tpu_hc_bench {' '.join(argv)}")
-    result = driver.run_benchmark(
-        cfg, layout=layout, fabric_name=fabric_name, print_fn=tee
-    )
+    rc = resilience.EXIT_OK
     try:
-        log_path.parent.mkdir(parents=True, exist_ok=True)
-        log_path.write_text("\n".join(lines) + "\n")
-        print(f"log: {log_path}")
-    except OSError:
-        pass
-    return 0 if result.total_images_per_sec > 0 else 1
+        result = driver.run_benchmark(
+            cfg, layout=layout, fabric_name=fabric_name, print_fn=tee
+        )
+        if result.total_images_per_sec <= 0:
+            rc = resilience.EXIT_ZERO_THROUGHPUT
+    except resilience.PreemptedError as e:
+        # graceful preemption: the emergency checkpoint is on disk (when
+        # --train_dir is set) — exit EXIT_PREEMPTED so the relauncher
+        # knows `--resume=auto` will continue, not restart
+        tee(str(e))
+        rc = resilience.EXIT_PREEMPTED
+    finally:
+        # the tee log is part of the contract even for preempted runs
+        try:
+            log_path.parent.mkdir(parents=True, exist_ok=True)
+            log_path.write_text("\n".join(lines) + "\n")
+            print(f"log: {log_path}")
+        except OSError:
+            pass
+    return rc
 
 
 if __name__ == "__main__":

@@ -139,11 +139,18 @@ class MetricsWriter:
     Disabled (every method a no-op) when ``out_dir`` is falsy or this is
     not process 0 — call sites never branch.  The manifest is written
     eagerly at construction so even a crashed run identifies itself.
+
+    Transient write errors retry with bounded backoff
+    (``resilience.retry``); a stream that keeps failing disables itself
+    with a stderr warning rather than killing a benchmark run over
+    telemetry.  ``last_record`` keeps the most recent record in memory —
+    the watchdog dumps it alongside the thread stacks when a run hangs.
     """
 
     def __init__(self, out_dir: str | None, manifest: dict | None = None,
                  primary: bool | None = None):
         self._f = None
+        self.last_record: dict | None = None
         if not out_dir:
             return
         if primary is None:
@@ -165,12 +172,38 @@ class MetricsWriter:
         return self._f is not None
 
     def event(self, kind: str, **fields) -> None:
-        if self._f is None:
-            return
         rec = {"kind": kind}
         rec.update(fields)
-        self._f.write(json.dumps(rec, default=str) + "\n")
-        self._f.flush()
+        self.last_record = rec
+        if self._f is None:
+            return
+        from tpu_hc_bench.resilience.retry import retry_io
+
+        line = json.dumps(rec, default=str) + "\n"
+        # a failed flush can leave ANY prefix of the line on disk (the
+        # rest sat in the userspace buffer), so a blind re-append could
+        # produce a corrupt fragment OR a duplicated record; rewinding
+        # to the pre-write offset makes the retry idempotent
+        pos = self._f.tell()
+
+        def _write():
+            self._f.seek(pos)
+            self._f.truncate()
+            self._f.write(line)
+            self._f.flush()
+
+        try:
+            retry_io(_write, what=f"metrics write ({kind})",
+                     attempts=3, base_delay_s=0.05)
+        except OSError as e:
+            sys.stderr.write(
+                f"WARNING: metrics stream disabled after repeated I/O "
+                f"errors: {e}\n")
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
 
     def close(self) -> None:
         if self._f is not None:
@@ -199,19 +232,43 @@ def resolve_run(path: str) -> tuple[str | None, str]:
 
 
 def read_run(path: str) -> tuple[dict, list[dict]]:
-    """Load ``(manifest, records)`` for a run (manifest {} if absent)."""
+    """Load ``(manifest, records)`` for a run (manifest {} if absent).
+
+    Tolerant of corrupt lines (a write that failed mid-flush and was
+    retried leaves a terminated fragment behind): they are skipped with
+    a stderr warning instead of crashing the CLI on exactly the run
+    whose telemetry survived an I/O incident.
+    """
     manifest_path, metrics_path = resolve_run(path)
     manifest = {}
     if manifest_path:
         with open(manifest_path) as f:
             manifest = json.load(f)
     records = []
+    corrupt = 0
     with open(metrics_path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 records.append(json.loads(line))
+            except json.JSONDecodeError:
+                corrupt += 1
+    if corrupt:
+        sys.stderr.write(
+            f"WARNING: {metrics_path}: skipped {corrupt} corrupt "
+            f"line(s) (interrupted write?)\n")
     return manifest, records
+
+
+# resilience-event record kinds (tpu_hc_bench.resilience): surfaced by
+# summarize_run so a run that skipped/rewound/retried its way to the
+# finish line says so instead of passing as clean
+RESILIENCE_KINDS = (
+    "injected_fault", "nonfinite_skip", "nonfinite_abort", "rewind",
+    "emergency_ckpt", "preempt", "watchdog_dump", "io_retry",
+)
 
 
 def _of_kind(records: list[dict], kind: str) -> list[dict]:
@@ -268,6 +325,17 @@ def summarize_run(path: str) -> list[str]:
                  for v in mem["devices"].values()]
         lines.append(f"  memory: peak {max(peaks) / 2**20:.1f} MiB/device "
                      f"({len(peaks)} device(s))")
+    res = [r for r in records if r.get("kind") in RESILIENCE_KINDS]
+    if res:
+        counts: dict[str, int] = {}
+        for r in res:
+            counts[r["kind"]] = counts.get(r["kind"], 0) + 1
+        lines.append("  resilience: " + "  ".join(
+            f"{k}x{counts[k]}" for k in RESILIENCE_KINDS if k in counts))
+        for r in res:
+            detail = " ".join(f"{k}={v}" for k, v in r.items()
+                              if k != "kind")
+            lines.append(f"    {r['kind']}: {detail}")
     tb = _last(records, "trace_buckets")
     if tb and tb.get("buckets"):
         total = sum(tb["buckets"].values()) or 1.0

@@ -1,0 +1,55 @@
+"""Runtime fault tolerance for benchmark/training runs.
+
+The reference harness treats every run as a disposable 150-step
+measurement — a NaN, a preempted VM, or a hung collective just kills the
+job (SURVEY.md §5).  Production TPU fleets live with preemption and
+silent numeric corruption as the common case, so this package makes runs
+*survive* the failures the analysis (PR 1) and observability (PR 2)
+layers can only report:
+
+- ``guards``     — jit-compatible non-finite detection on loss/grad
+                   global norm with an ``--on_nonfinite={abort,skip,
+                   rewind}`` policy and a consecutive-failure budget.
+- ``preempt``    — SIGTERM/SIGINT → flag polled at step boundaries →
+                   one emergency checkpoint + metrics flush → distinct
+                   exit code; ``--resume=auto`` closes the loop.
+- ``watchdog``   — monitor thread over the driver's step-completion
+                   markers; on ``--step_timeout_s`` of silence it dumps
+                   every Python thread stack + the last metrics record
+                   and aborts instead of hanging a cluster forever.
+- ``inject``     — ``--inject_fault=nan_loss@40,hang@80:30,sigterm@120,
+                   io_error@ckpt`` deterministic fault injection, so
+                   every recovery path is exercised by real tests.
+- ``retry``      — bounded retry-with-backoff for checkpoint/metrics
+                   I/O errors.
+
+Every resilience event (``nonfinite_skip``, ``rewind``,
+``emergency_ckpt``, ``preempt``, ``watchdog_dump``, ``injected_fault``,
+``io_retry``) is emitted as a structured record into the PR-2 metrics
+stream, so ``python -m tpu_hc_bench.obs summarize`` shows them.
+
+Process exit-code contract (documented in README.md, returned by
+``launcher.main`` / asserted by the subprocess tests):
+"""
+
+# Exit codes: chosen from/near the BSD sysexits range so they never
+# collide with shell (1/2), signal (128+N), or Python (1) conventions.
+EXIT_OK = 0                 # clean run, nonzero throughput measured
+EXIT_ZERO_THROUGHPUT = 1    # run completed but measured no progress
+EXIT_WATCHDOG = 70          # watchdog abort: no step completed within
+                            # --step_timeout_s (EX_SOFTWARE: the only
+                            # trustworthy signal when a collective
+                            # deadlocks — stacks were dumped to stderr)
+EXIT_PREEMPTED = 75         # SIGTERM/SIGINT honored: emergency
+                            # checkpoint written, relaunch with
+                            # --resume=auto to continue (EX_TEMPFAIL)
+
+from tpu_hc_bench.resilience.guards import (   # noqa: E402
+    GuardBudgetError, NonFiniteError,
+)
+from tpu_hc_bench.resilience.preempt import PreemptedError  # noqa: E402
+
+__all__ = [
+    "EXIT_OK", "EXIT_ZERO_THROUGHPUT", "EXIT_WATCHDOG", "EXIT_PREEMPTED",
+    "GuardBudgetError", "NonFiniteError", "PreemptedError",
+]

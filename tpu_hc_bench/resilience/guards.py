@@ -1,0 +1,115 @@
+"""Non-finite step guards: detect NaN/inf loss or gradients in-step.
+
+The PaLM training report's loss-spike protocol (PAPERS.md) is the
+standard answer to silent numeric corruption: detect the bad step,
+refuse its update, and either continue (``skip``) or restart from the
+last good state with the offending data skipped (``rewind``).  The
+detection here is **jit-compatible** — ``finite_flag`` runs inside the
+compiled train step, and ``select_state`` drops the update with a
+``jnp.where`` select *inside the same compiled program*, which is the
+only donation-safe way to do it: the input state buffers are donated to
+the step, so a host-side "keep the old state" after the fact would read
+freed buffers.  The state is threaded through the select instead.
+
+Budget accounting (``GuardTracker``) stays on device as two int32
+scalars updated by a tiny jitted program per step — no host sync in the
+dispatch path.  The driver polls them (one scalar fetch) once per sync
+window, which is where the ``--max_bad_steps`` consecutive-failure
+budget is enforced so a poisoned run still terminates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class NonFiniteError(RuntimeError):
+    """A non-finite loss/gradient was detected and policy says die."""
+
+
+class GuardBudgetError(NonFiniteError):
+    """The --max_bad_steps consecutive-failure budget was exhausted."""
+
+
+def finite_flag(loss, grads=None):
+    """Scalar bool: loss (and, when given, the gradient global norm)
+    are all finite.  Traceable — call inside the compiled step."""
+    ok = jnp.isfinite(loss)
+    if grads is not None and jax.tree.leaves(grads):
+        ok = ok & jnp.isfinite(optax.global_norm(grads))
+    return ok
+
+
+def select_state(ok, new_state, old_state):
+    """Thread the state through a select: the updated tree when ``ok``,
+    the incoming tree otherwise (the ``skip`` policy's dropped update).
+    Must run inside the same compiled program as the update, so donation
+    of the input state stays sound."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(ok, n, o), new_state, old_state)
+
+
+def nonfinite_metric(ok):
+    """The per-step guard metric: 1 when the step was bad, else 0."""
+    return jnp.where(ok, 0, 1).astype(jnp.int32)
+
+
+class GuardTracker:
+    """Device-side (streak, total, peak) counters over the per-step
+    guard flag.
+
+    ``update`` dispatches one tiny jitted program per step (async, no
+    host round trip); ``poll`` fetches the scalars — the one deliberate
+    sync point, paid once per sync window by the driver.  ``peak`` is
+    the longest streak ever seen, so a consecutive-failure run that
+    ends *inside* a window (streak already reset to 0 by a good step at
+    the boundary) still trips the --max_bad_steps budget.
+    """
+
+    def __init__(self):
+        self.reset()
+
+    @staticmethod
+    @jax.jit
+    def _advance(streak, total, peak, bad):
+        bad = (bad > 0).astype(jnp.int32)
+        streak = jnp.where(bad > 0, streak + 1, 0)
+        return streak, total + bad, jnp.maximum(peak, streak)
+
+    def update(self, bad) -> None:
+        self._streak, self._total, self._peak = self._advance(
+            self._streak, self._total, self._peak, bad)
+
+    def poll(self) -> tuple[int, int, int]:
+        """Fetch ``(consecutive_bad, total_bad, peak_consecutive)`` —
+        syncs the tracker."""
+        streak, total, peak = jax.device_get(
+            [self._streak, self._total, self._peak])
+        return int(streak), int(total), int(peak)
+
+    def reset(self) -> None:
+        self._streak = jnp.zeros((), jnp.int32)
+        self._total = jnp.zeros((), jnp.int32)
+        self._peak = jnp.zeros((), jnp.int32)
+
+
+def guard_mode(cfg) -> str:
+    """The step builders' guard wiring for a resolved config.
+
+    ``"skip"``  — detect AND drop bad updates via the in-step select;
+    ``"flag"``  — detect only (the ``rewind`` policy restores a
+    checkpoint, so the poisoned update needs no select);
+    ``"off"``   — no guard ops in the compiled step (``abort`` checks
+    the display-step losses the timeline already fetches, at zero cost
+    to the hot path; forward-only steps have no update to protect).
+    """
+    policy = getattr(cfg, "on_nonfinite", "abort")
+    if getattr(cfg, "forward_only", False) or getattr(cfg, "eval", False):
+        return "off"
+    if policy == "skip":
+        return "skip"
+    if policy == "rewind":
+        return "flag"
+    return "off"

@@ -1,0 +1,146 @@
+"""Deterministic fault injection: ``--inject_fault=CLASS@WHERE[,...]``.
+
+Every recovery path in this package is exercised by *real* injected
+failures, not hope.  The grammar names a failure class and the timed
+step (or target) it fires at:
+
+- ``nan_loss@N``   — poison step N's batch (float leaves × NaN), so the
+                     loss AND gradients of that step are non-finite —
+                     exercises the ``--on_nonfinite`` guard end to end.
+- ``hang@N:S``     — sleep S seconds before dispatching step N
+                     (completion markers stop arriving — the hung-
+                     collective signature the watchdog exists for).
+- ``sigterm@N``    — ``kill(self, SIGTERM)`` before step N — exercises
+                     the preemption → emergency-checkpoint → resume path.
+- ``io_error@ckpt``— the next checkpoint save raises ``OSError`` once —
+                     exercises the bounded retry-with-backoff.
+
+Entries may repeat (``nan_loss@3,nan_loss@4``).  Parsing is loud:
+``flags.resolve()`` validates the spec at flag time, not after 50
+warmup steps.  Each fired fault is printed and emitted as an
+``injected_fault`` record into the metrics stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+
+_USAGE = (
+    "--inject_fault grammar: comma-separated entries of "
+    "nan_loss@STEP | hang@STEP:SECONDS | sigterm@STEP | io_error@ckpt"
+)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    nan_loss: frozenset[int]
+    hang: dict[int, float]          # step -> seconds
+    sigterm: frozenset[int]
+    io_error: set[str]              # targets, one-shot (disarmed on fire)
+
+    def __bool__(self) -> bool:
+        return bool(self.nan_loss or self.hang or self.sigterm
+                    or self.io_error)
+
+    def fire_step_faults(self, step: int, print_fn, obs_writer=None) -> None:
+        """Host-side faults that fire *before* step ``step`` dispatches."""
+        if step in self.hang:
+            seconds = self.hang[step]
+            self._announce(print_fn, obs_writer, "hang", step,
+                           seconds=seconds)
+            time.sleep(seconds)
+        if step in self.sigterm:
+            self._announce(print_fn, obs_writer, "sigterm", step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def poison_batch(self, step: int, batch, print_fn, obs_writer=None):
+        """nan_loss: multiply every float leaf of step ``step``'s batch
+        by NaN (integer leaves — labels, token ids — pass through)."""
+        if step not in self.nan_loss:
+            return batch
+        import jax
+        import jax.numpy as jnp
+
+        leaves = jax.tree.leaves(batch)
+        if not any(jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                   for x in leaves):
+            raise ValueError(
+                f"inject_fault=nan_loss@{step}: the batch has no float "
+                "leaves to poison (token/id inputs are integers); use an "
+                "image or speech model")
+        self._announce(print_fn, obs_writer, "nan_loss", step)
+        return jax.tree.map(
+            lambda x: x * jnp.nan
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            batch)
+
+    def maybe_io_error(self, target: str) -> None:
+        """One-shot OSError for ``io_error@<target>`` (disarms on fire);
+        called from inside the retried I/O path."""
+        if target in self.io_error:
+            self.io_error.discard(target)
+            raise OSError(f"injected io_error@{target}")
+
+    @staticmethod
+    def _announce(print_fn, obs_writer, fault: str, step: int,
+                  **fields) -> None:
+        detail = "".join(f" {k}={v}" for k, v in fields.items())
+        print_fn(f"inject: {fault} at timed step {step}{detail}")
+        if obs_writer is not None:
+            obs_writer.event("injected_fault", fault=fault, step=step,
+                             **fields)
+
+
+def parse_plan(spec: str | None) -> FaultPlan | None:
+    """Parse the --inject_fault grammar; None/empty spec -> None."""
+    if not spec:
+        return None
+    nan_loss: set[int] = set()
+    hang: dict[int, float] = {}
+    sigterm: set[int] = set()
+    io_error: set[str] = set()
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        cls, sep, arg = entry.partition("@")
+        if not sep or not arg:
+            raise ValueError(f"malformed entry {entry!r}; {_USAGE}")
+        try:
+            if cls == "nan_loss":
+                nan_loss.add(_step(arg))
+            elif cls == "hang":
+                at, sep2, secs = arg.partition(":")
+                if not sep2:
+                    raise ValueError
+                hang[_step(at)] = _seconds(secs)
+            elif cls == "sigterm":
+                sigterm.add(_step(arg))
+            elif cls == "io_error":
+                if arg != "ckpt":
+                    raise ValueError
+                io_error.add(arg)
+            else:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"malformed entry {entry!r}; {_USAGE}") from None
+    return FaultPlan(nan_loss=frozenset(nan_loss), hang=hang,
+                     sigterm=frozenset(sigterm), io_error=io_error)
+
+
+def _step(s: str) -> int:
+    step = int(s)
+    if step < 1:
+        raise ValueError
+    return step
+
+
+def _seconds(s: str) -> float:
+    seconds = float(s)
+    if seconds <= 0:
+        raise ValueError
+    return seconds

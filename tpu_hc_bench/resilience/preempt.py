@@ -1,0 +1,114 @@
+"""Preemption handling: SIGTERM/SIGINT → graceful emergency checkpoint.
+
+TPU fleets preempt VMs with a SIGTERM and a short grace window
+(the Pathways-style elastic-training pattern in PAPERS.md).  The
+handler here only *sets a flag*; the driver's step loop polls it at
+step boundaries — the one place the training state is consistent — and
+then writes one emergency checkpoint, flushes the metrics stream, and
+raises :class:`PreemptedError`, which ``launcher.main`` maps to the
+distinct ``EXIT_PREEMPTED`` code.  ``kill -TERM <pid>`` → relaunch with
+``--resume=auto`` → continue-from-step just works.
+
+Multi-host: every process gets its own signal (or none — preemption
+notices are per-VM), and a checkpoint written by half a mesh is
+garbage, so the decision to stop is made **collectively** through
+``utils.sync.all_processes_any`` — the shared cross-host agreement
+primitive — at sync-window boundaries (the same step on every process,
+as a collective requires).
+
+A second signal while the first is still being honored restores the
+original disposition, so an operator's double Ctrl-C still kills a run
+stuck in its own emergency save.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Callable
+
+
+class PreemptedError(RuntimeError):
+    """The run stopped at a step boundary to honor a preemption signal.
+
+    ``launcher.main`` maps this to ``resilience.EXIT_PREEMPTED`` (75).
+    """
+
+    def __init__(self, step: int, checkpoint_saved: bool,
+                 signum: int | None = None):
+        self.step = step
+        self.checkpoint_saved = checkpoint_saved
+        self.signum = signum
+        ckpt = ("emergency checkpoint saved; relaunch with --resume=auto "
+                "to continue" if checkpoint_saved
+                else "no --train_dir, nothing saved")
+        super().__init__(
+            f"preempted after timed step {step} "
+            f"(signal {signum}): {ckpt}")
+
+
+class PreemptionHandler:
+    """Installable SIGTERM/SIGINT flag; poll with ``requested``/``agreed``.
+
+    ``install`` is a no-op outside the main thread (CPython only
+    delivers signals there) and restores the previous handlers on
+    ``uninstall`` — safe to wrap around a library call under pytest.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, print_fn: Callable[[str], None] = print):
+        self._event = threading.Event()
+        self._print = print_fn
+        self._saved: dict[int, object] = {}
+        self.signum: int | None = None
+
+    @property
+    def active(self) -> bool:
+        return bool(self._saved)
+
+    def install(self) -> "PreemptionHandler":
+        if threading.current_thread() is not threading.main_thread():
+            return self        # signals never arrive here; stay inert
+        for sig in self.SIGNALS:
+            self._saved[sig] = signal.signal(sig, self._on_signal)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, old in self._saved.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, TypeError):  # not main thread / odd saved
+                pass
+        self._saved.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._event.is_set():
+            # second signal: the graceful path is already running (or
+            # stuck) — restore the original disposition and RE-DELIVER,
+            # so this very signal already gets default handling (an
+            # operator's second Ctrl-C must not be swallowed)
+            self.uninstall()
+            signal.raise_signal(signum)
+            return
+        self.signum = signum
+        self._event.set()
+        self._print(
+            f"signal {signum} received: will checkpoint and exit at the "
+            f"next step boundary (send again to force default handling)")
+
+    def requested(self) -> bool:
+        """This process saw a signal (cheap local check, poll freely)."""
+        return self._event.is_set()
+
+    def agreed(self, world: int) -> bool:
+        """Cross-host agreement to stop: True iff ANY process requested.
+
+        With ``world > 1`` this is a collective — every process must
+        call it at the same step boundary.
+        """
+        if world <= 1:
+            return self.requested()
+        from tpu_hc_bench.utils.sync import all_processes_any
+
+        return all_processes_any(self.requested())

@@ -1,0 +1,171 @@
+"""Hung-step watchdog: abort with diagnostics instead of hanging forever.
+
+When a collective deadlocks (one host died mid-allreduce, a DCN link
+flapped), every surviving process blocks inside XLA with no exception
+to catch — the step loop just stops completing steps.  The only
+trustworthy signal is *absence of progress*, and the driver already has
+the perfect progress oracle: the per-step completion markers of the
+arrival-fetcher timeline.  The watchdog is a monitor thread over that
+timestamp; if no step completes within ``--step_timeout_s`` it dumps
+every Python thread's stack (``faulthandler`` — works even while the
+main thread is stuck in C++) plus the last metrics record to stderr,
+emits a ``watchdog_dump`` record, and exits the process with the
+distinct ``EXIT_WATCHDOG`` code so the scheduler reaps the job instead
+of billing a wedged cluster forever.
+
+``--step_timeout_s=auto`` calibrates from the measured warmup: any
+healthy step — including a recompile — finishes well inside
+``AUTO_TIMEOUT_MULT ×`` the mean warmup step time (which includes the
+full compile), floored at ``AUTO_TIMEOUT_MIN_S``.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+AUTO_TIMEOUT_MULT = 10.0
+AUTO_TIMEOUT_MIN_S = 60.0
+
+
+def resolve_timeout(spec: str | float | None,
+                    warmup_step_s: float | None = None) -> float | None:
+    """``--step_timeout_s`` → seconds (or None = watchdog off).
+
+    Accepts a positive number, ``"auto"`` (k× the warmup mean step time,
+    floored — ``warmup_step_s`` must be provided then), or
+    None/""/"0"/"off" to disable.  Loud on anything else.
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        s = spec.strip().lower()
+        if s in ("", "0", "off", "none"):
+            return None
+        if s == "auto":
+            if warmup_step_s is None:
+                return None     # caller resolves again post-warmup
+            return max(AUTO_TIMEOUT_MIN_S,
+                       AUTO_TIMEOUT_MULT * warmup_step_s)
+        spec = s
+    try:
+        timeout = float(spec)
+    except ValueError:
+        raise ValueError(
+            f"--step_timeout_s must be a positive number, 'auto', or "
+            f"unset/off: {spec!r}") from None
+    if timeout <= 0:
+        raise ValueError(
+            f"--step_timeout_s must be > 0 (use unset/off to disable): "
+            f"{spec!r}")
+    return timeout
+
+
+class Watchdog:
+    """Monitor thread: no completed step for ``timeout_s`` → dump + abort.
+
+    ``progress_fn`` returns the wall time (``time.perf_counter``) of the
+    last completed step, or None before the first one; the arming time
+    stands in until then.  ``on_timeout`` (tests) replaces the default
+    ``os._exit(EXIT_WATCHDOG)`` so the firing path is unit-testable
+    in-process.
+    """
+
+    def __init__(self, timeout_s: float,
+                 progress_fn: Callable[[], float | None],
+                 print_fn: Callable[[str], None] = print,
+                 last_record_fn: Callable[[], Any] | None = None,
+                 obs_writer: Any = None,
+                 on_timeout: Callable[[float], None] | None = None,
+                 poll_s: float | None = None):
+        self.timeout_s = float(timeout_s)
+        self._progress = progress_fn
+        self._print = print_fn
+        self._last_record = last_record_fn
+        self._obs = obs_writer
+        self._on_timeout = on_timeout
+        self._poll_s = poll_s or max(0.05, min(5.0, self.timeout_s / 4))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._armed_t = 0.0
+        self._paused = False
+        self.fired = False
+
+    def start(self) -> "Watchdog":
+        self._armed_t = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-hc-bench-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self._poll_s)
+
+    def pause(self) -> None:
+        """Suspend timeout checks — around legitimate long stalls the
+        progress oracle cannot see (a multi-GB checkpoint save to slow
+        storage blocks the step loop but is NOT a hang)."""
+        self._paused = True
+
+    def resume(self) -> None:
+        """Re-arm with a fresh baseline: the paused span must not count
+        against the next step's timeout."""
+        self._armed_t = time.perf_counter()
+        self._paused = False
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            if self._paused:
+                continue
+            last = self._progress()
+            if last is None or last < self._armed_t:
+                last = self._armed_t
+            age = time.perf_counter() - last
+            if age > self.timeout_s:
+                self._fire(age)
+                return
+
+    def _fire(self, age: float) -> None:
+        self.fired = True
+        sys.stderr.write(
+            f"\nwatchdog: no step completed in {age:.1f}s "
+            f"(timeout {self.timeout_s:.1f}s) — dumping all thread "
+            f"stacks and aborting (exit {_exit_code()})\n")
+        try:
+            # C-level dump: works even when the main thread is wedged
+            # inside an XLA collective and will never run Python again
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:
+            pass
+        if self._last_record is not None:
+            try:
+                rec = self._last_record()
+                if rec is not None:
+                    sys.stderr.write(f"watchdog: last metrics record: "
+                                     f"{rec}\n")
+            except Exception:
+                pass
+        if self._obs is not None:
+            try:
+                self._obs.event("watchdog_dump", age_s=age,
+                                timeout_s=self.timeout_s)
+                self._obs.close()
+            except Exception:
+                pass
+        sys.stderr.flush()
+        if self._on_timeout is not None:
+            self._on_timeout(age)
+            return
+        os._exit(_exit_code())
+
+
+def _exit_code() -> int:
+    from tpu_hc_bench.resilience import EXIT_WATCHDOG
+
+    return EXIT_WATCHDOG

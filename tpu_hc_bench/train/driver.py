@@ -25,6 +25,11 @@ from tpu_hc_bench.obs import metrics as obs_metrics
 from tpu_hc_bench.models import create_model
 from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
 from tpu_hc_bench.parallel import fabric as fabric_mod
+from tpu_hc_bench.resilience import (
+    guards as guards_mod, inject as inject_mod, preempt as preempt_mod,
+    watchdog as watchdog_mod,
+)
+from tpu_hc_bench.resilience.retry import retry_io
 from tpu_hc_bench.topology import (
     DATA_AXIS, Layout, SEQ_AXIS, build_mesh, discover_layout,
 )
@@ -128,7 +133,9 @@ class _ArrivalFetcher:
         self.skipped: list[tuple[int, object]] = []   # coalesced-over markers
         self._keep_value = keep_value or (lambda i: True)
         self.fetched_step = 0
+        self.last_arrival_t: float | None = None   # watchdog progress oracle
         self.error: BaseException | None = None
+        self._error_tb = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -137,9 +144,18 @@ class _ArrivalFetcher:
         self._q.put((step_idx, handle))
 
     def check(self) -> None:
-        """Re-raise a fetch error (XlaRuntimeError, OOM…) in the caller."""
+        """Re-raise a fetch error (XlaRuntimeError, OOM…) in the caller,
+        with the ORIGINAL fetch-thread traceback attached — the step loop
+        fails with the real error, not a context-free re-raise."""
         if self.error is not None:
-            raise self.error
+            exc = self.error
+            if hasattr(exc, "add_note") and not getattr(
+                    exc, "_tpu_hc_noted", False):
+                exc.add_note(
+                    "raised in the arrival-fetch thread; re-raised in the "
+                    "step loop (tpu_hc_bench.train.driver._ArrivalFetcher)")
+                exc._tpu_hc_noted = True
+            raise exc.with_traceback(self._error_tb)
 
     def _run(self) -> None:
         import queue as queue_mod
@@ -165,9 +181,11 @@ class _ArrivalFetcher:
                 v = jax.device_get(h)
             except BaseException as e:   # surface in main thread, don't hang
                 self.error = e
+                self._error_tb = e.__traceback__
                 self.fetched_step = 1 << 60   # unblock flow-control spins
                 return
             self.arrivals.append((i, time.perf_counter(), v))
+            self.last_arrival_t = time.perf_counter()
             self.fetched_step = i
 
     def finish(self) -> list[tuple[int, float, object]]:
@@ -379,8 +397,23 @@ class _TraceWindow:
         return summary.totals
 
 
+def _fingerprint_line(params, print_fn) -> None:
+    """Best-effort params digest: emergency save and resume restore both
+    print it, so kill/resume tests assert bitwise identity from the log.
+    Silent when the state is not fully addressable (multi-host sharded)."""
+    from tpu_hc_bench.utils import checkpoint as ckpt
+
+    try:
+        print_fn(f"params fingerprint: {ckpt.fingerprint(params)}")
+    except Exception:
+        pass
+
+
 def _maybe_restore(state, cfg, print_fn, sharded=False):
-    """--train_dir resume: restore the latest checkpoint if one exists.
+    """--train_dir resume: restore the latest COMPLETE checkpoint, per
+    the ``--resume`` policy (auto = restore if one exists, never = fresh
+    init, must = error when none — a crash-looping relaunch must not
+    silently restart from step 0).
 
     Returns ``(state, restored?)``.  Default mode restores host arrays
     (the caller re-places them on the mesh); ``sharded=True`` takes an
@@ -388,15 +421,36 @@ def _maybe_restore(state, cfg, print_fn, sharded=False):
     sharding, every process reading only its addressable shards (the
     multi-host model-sharded path).
     """
-    if not cfg.train_dir:
+    if not cfg.train_dir or cfg.resume == "never":
         return state, False
+    from pathlib import Path
+
     from tpu_hc_bench.utils import checkpoint as ckpt
 
     if ckpt.latest_step(cfg.train_dir) is None:
+        orphans = [p.name for p in Path(cfg.train_dir).glob("step_*")
+                   if p.is_dir() and not p.name.endswith(".tmp")]
+        if orphans:
+            # crashed saves — or checkpoints from before the commit-
+            # sentinel scheme.  Never restore them silently, but never
+            # silently restart from step 0 over them either.
+            print_fn(
+                f"WARNING: {cfg.train_dir} has step dir(s) without a "
+                f"commit sentinel ({', '.join(sorted(orphans)[:4])}"
+                f"{'...' if len(orphans) > 4 else ''}): crashed saves, "
+                f"or pre-sentinel checkpoints — verify and `touch "
+                f"<dir>/step_NNNNNNNN.complete` to adopt; starting "
+                f"fresh")
+        if cfg.resume == "must":
+            raise FileNotFoundError(
+                f"--resume=must: no complete checkpoint under "
+                f"{cfg.train_dir}")
         return state, False
     state = ckpt.restore(state, cfg.train_dir, sharded=sharded)
     print_fn(f"restored checkpoint step "
              f"{int(jax.device_get(state.step))} from {cfg.train_dir}")
+    if not sharded:
+        _fingerprint_line(state.params, print_fn)
     return state, True
 
 
@@ -603,6 +657,14 @@ def run_benchmark(
             "(ici/dcn): the host path's shard_map binds no seq axis and "
             "would silently re-replicate the shards"
         )
+    if (cfg.on_nonfinite in ("skip", "rewind")
+            and fab is fabric_mod.Fabric.HOST):
+        # flags.resolve rejects the other unsupported arms; the fabric is
+        # only known here
+        raise ValueError(
+            "--on_nonfinite=skip/rewind needs a compiled step (fabric "
+            "ici/dcn): the host-fabric numpy step carries no in-step "
+            "guard")
     # fabric=dcn selects the MULTISLICE layout: slices x hosts/slice x
     # chips, a leading `dcn` mesh axis splitting the data dimension so the
     # gradient allreduce's cross-slice phase is explicit (the reference's
@@ -901,6 +963,8 @@ def run_benchmark(
 
     # --- state + step ---
     pp_save_ctx = None     # (model, template) when PP saves need restacking
+    place_fn = None        # re-place a host-restored state on the mesh (the
+                           # --on_nonfinite=rewind mid-run restore path)
     if sp_active:
         print_fn(f"sequence parallel: {sp} shards x "
                  f"{spec.input_shape[0] // sp} tokens/shard "
@@ -917,9 +981,10 @@ def run_benchmark(
             # DP x SP x TP: params/opt model-sharded (auto axis), the SP
             # step's shard_map stays manual over data+seq only
             print_fn(f"tensor parallel: {tp}-way (hybrid with SP)")
-            state = step_mod.shard_state_tp(state, mesh)
+            place_fn = lambda s: step_mod.shard_state_tp(s, mesh)
         else:
-            state = step_mod.replicate_state(state, mesh)
+            place_fn = lambda s: step_mod.replicate_state(s, mesh)
+        state = place_fn(state)
         if sharded_ckpt:
             # multi-host SP x TP (round 4): same restore-after-placement
             # as the plain TP arm — Orbax reads each array straight into
@@ -978,7 +1043,13 @@ def run_benchmark(
 
             params, opt_state = pipe_mod.make_pp_state(model, cfg, batch[0],
                                                        mesh, tp=tp > 1)
-            if ckpt_mod.latest_step(cfg.train_dir) is not None:
+            if (cfg.resume == "must"
+                    and ckpt_mod.latest_step(cfg.train_dir) is None):
+                raise FileNotFoundError(
+                    f"--resume=must: no complete checkpoint under "
+                    f"{cfg.train_dir}")
+            if (cfg.resume != "never"
+                    and ckpt_mod.latest_step(cfg.train_dir) is not None):
                 if cfg.eval:
                     params, _, pp_base_step = ckpt_mod.restore_pp(
                         params, None, cfg.train_dir)
@@ -1049,9 +1120,10 @@ def run_benchmark(
             state, restored = _maybe_restore(state, cfg, print_fn)
         if mp > 1:
             mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
-            state = step_mod.shard_state_tp(state, mesh, mode)
+            place_fn = lambda s, m=mode: step_mod.shard_state_tp(s, mesh, m)
         else:
-            state = step_mod.replicate_state(state, mesh)
+            place_fn = lambda s: step_mod.replicate_state(s, mesh)
+        state = place_fn(state)
         if sharded_ckpt:
             # multi-host TP/EP: restore AFTER placement so Orbax reads
             # each array straight into its committed sharding
@@ -1080,9 +1152,10 @@ def run_benchmark(
         state, metrics = train_step(state, next(batch_iter),
                                     jax.random.fold_in(rng, w))
     drain(metrics["loss"])
+    warmup_elapsed = time.perf_counter() - t_compile
     print_fn(
         f"warmup done: {cfg.num_warmup_batches} steps in "
-        f"{time.perf_counter() - t_compile:.1f}s (includes compile)"
+        f"{warmup_elapsed:.1f}s (includes compile)"
     )
 
     # --- timed loop (reference num_batches=100, display_every=10) ---
@@ -1101,46 +1174,253 @@ def run_benchmark(
     trace_window = _TraceWindow(cfg, print_fn, timeline.sync_every)
     timeline.start(metrics["loss"])
     warmup_steps = max(1, cfg.num_warmup_batches)
+
+    # --- resilience runtime (round 8): fault-injection plan, preemption
+    # handler, hung-step watchdog, non-finite guard tracking.  The guard
+    # itself runs INSIDE the compiled step (train/step.py); here the
+    # driver threads its per-step flag into device-side counters and pays
+    # one scalar fetch per sync window to enforce policy.
+    plan = inject_mod.parse_plan(cfg.inject_fault)
+    policy = cfg.on_nonfinite
+    tracker = (guards_mod.GuardTracker()
+               if policy in ("skip", "rewind") else None)
+    world = jax.process_count()
+    preempt_h = preempt_mod.PreemptionHandler(print_fn=print_fn).install()
+    timeout_s = watchdog_mod.resolve_timeout(
+        cfg.step_timeout_s, warmup_elapsed / warmup_steps)
+    dog = None
+
     def save_now(i: int) -> None:
-        if pp_native_ckpt:
+        def _do() -> None:
+            if plan is not None:
+                plan.maybe_io_error("ckpt")
+            if pp_native_ckpt:
+                from tpu_hc_bench.utils import checkpoint as ckpt_mod
+
+                p, o = state
+                path = ckpt_mod.save_pp(
+                    p, o, pp_base_step + warmup_steps + i, cfg.train_dir)
+                print_fn(f"checkpoint saved: {path} (PP-native)")
+                return
+            ctx = None
+            if pp_save_ctx is not None:
+                pp_model, pp_template, pp_base = pp_save_ctx
+                # resume-aware stamp: continue the restored checkpoint's
+                # step count so a resumed PP run never saves under a
+                # lower step
+                ctx = (pp_model, pp_template, pp_base + warmup_steps + i)
+            _save_state(state, cfg, print_fn, pp_ctx=ctx,
+                        sharded=sharded_ckpt)
+
+        # a multi-GB save to slow storage stalls the step loop
+        # legitimately — the watchdog must not count it as a hang
+        if dog is not None:
+            dog.pause()
+        try:
+            # multi-host saves are COLLECTIVE (Orbax barriers + the
+            # commit-sentinel wait): a one-sided retry would leave the
+            # retrier alone in a barrier, so retries are single-host only
+            retry_io(_do, what="checkpoint save", print_fn=print_fn,
+                     obs_writer=obs_writer,
+                     attempts=1 if world > 1 else 3)
+            if cfg.keep_checkpoints and cfg.train_dir:
+                from tpu_hc_bench.utils import checkpoint as ckpt_mod
+
+                ckpt_mod.gc_checkpoints(cfg.train_dir,
+                                        cfg.keep_checkpoints,
+                                        print_fn=print_fn)
+        finally:
+            if dog is not None:
+                dog.resume()
+
+    def _emergency(completed: int) -> None:
+        """Preemption honored at a step boundary: one emergency
+        checkpoint, metrics flush, distinct exit (launcher maps the
+        raised PreemptedError to EXIT_PREEMPTED)."""
+        print_fn(f"preemption: stopping after timed step {completed} "
+                 f"(signal {preempt_h.signum})")
+        saved = bool(cfg.train_dir)
+        if saved and tracker is not None:
+            # settle the guard first: under rewind the state may carry
+            # poisoned mid-window updates, and the emergency checkpoint
+            # must never persist them for --resume=auto to restore
+            try:
+                _poll_guard(completed)
+            except guards_mod.GuardBudgetError:
+                saved = False   # budget died on poisoned state: keep it
+                                # off disk, exit preempted without a save
+        if saved:
+            save_now(completed)
+            if not pp_native_ckpt:
+                _fingerprint_line(
+                    state.params if hasattr(state, "params") else state[0],
+                    print_fn)
+            obs_writer.event("emergency_ckpt", step=completed)
+        obs_writer.event("preempt", step=completed,
+                         signal=preempt_h.signum, checkpoint_saved=saved)
+        obs_writer.close()
+        raise preempt_mod.PreemptedError(completed, saved, preempt_h.signum)
+
+    guard_seen_total = 0
+    guard_last_poll_i = 0
+    rewind_streak = 0
+
+    def _poll_guard(i: int) -> None:
+        """Sync-window guard poll: enforce --max_bad_steps, emit events,
+        run the rewind restore.  The one deliberate host sync of the
+        resilience path (skip/rewind policies only)."""
+        nonlocal guard_seen_total, guard_last_poll_i, rewind_streak, state
+        steps_since = i - guard_last_poll_i
+        guard_last_poll_i = i
+        streak, total, peak = tracker.poll()
+        new_bad = total - guard_seen_total
+        if new_bad <= 0:
+            # only a CLEAN window with actual steps in it breaks a rewind
+            # streak — a second poll at the same step (the settle-before-
+            # save path) must not erase the budget accounting
+            if steps_since > 0:
+                rewind_streak = 0
+            return
+        guard_seen_total = total
+        if policy == "skip":
+            print_fn(f"nonfinite: dropped {new_bad} update(s) in window "
+                     f"ending step {i} (consecutive {streak}, "
+                     f"total {total})")
+            obs_writer.event("nonfinite_skip", step=i, new_bad=new_bad,
+                             streak=streak, total=total)
+            # budget on the PEAK streak: a consecutive run that ended
+            # inside the window (streak already reset by a good step)
+            # still counts
+            if peak >= cfg.max_bad_steps:
+                obs_writer.close()
+                raise guards_mod.GuardBudgetError(
+                    f"{peak} consecutive non-finite steps "
+                    f"(--max_bad_steps={cfg.max_bad_steps})")
+            return
+        # rewind: restore the last complete checkpoint and re-enter the
+        # loop with a skip-window over the offending data batches.
+        # Budget matches the skip policy's: the run dies on the
+        # max_bad_steps-th consecutive bad window.
+        rewind_streak += 1
+        if rewind_streak >= cfg.max_bad_steps:
+            obs_writer.close()
+            raise guards_mod.GuardBudgetError(
+                f"{rewind_streak} consecutive rewinds without a clean "
+                f"window (--max_bad_steps={cfg.max_bad_steps})")
+        from tpu_hc_bench.utils import checkpoint as ckpt_mod
+
+        if dog is not None:
+            dog.pause()     # a long restore from slow storage is not a hang
+        try:
+            restored = ckpt_mod.restore(state, cfg.train_dir,
+                                        sharded=sharded_ckpt)
+            state = restored if sharded_ckpt else place_fn(restored)
+        finally:
+            if dog is not None:
+                dog.resume()
+        restored_step = int(np.asarray(jax.device_get(restored.step)))
+        skip_n = timeline.sync_every
+        for _ in range(skip_n):
+            next(batch_iter)
+        tracker.reset()
+        guard_seen_total = 0
+        print_fn(f"rewind: non-finite step(s) in window ending step {i}; "
+                 f"restored checkpoint step {restored_step}, skipping "
+                 f"{skip_n} batches")
+        obs_writer.event("rewind", step=i, restored_step=restored_step,
+                         skipped_batches=skip_n, streak=streak)
+
+    try:
+        if timeout_s is not None:
+            dog = watchdog_mod.Watchdog(
+                timeout_s, lambda: timeline.fetcher.last_arrival_t,
+                print_fn=print_fn,
+                last_record_fn=lambda: obs_writer.last_record,
+                obs_writer=obs_writer).start()
+            print_fn(f"watchdog armed: step timeout {timeout_s:.1f}s")
+        if policy == "rewind":
             from tpu_hc_bench.utils import checkpoint as ckpt_mod
 
-            p, o = state
-            path = ckpt_mod.save_pp(p, o, pp_base_step + warmup_steps + i,
-                                    cfg.train_dir)
-            print_fn(f"checkpoint saved: {path} (PP-native)")
-            return
-        ctx = None
-        if pp_save_ctx is not None:
-            pp_model, pp_template, pp_base = pp_save_ctx
-            # resume-aware stamp: continue the restored checkpoint's step
-            # count so a resumed PP run never saves under a lower step
-            ctx = (pp_model, pp_template, pp_base + warmup_steps + i)
-        _save_state(state, cfg, print_fn, pp_ctx=ctx,
-                    sharded=sharded_ckpt)
-
-    for i in range(1, cfg.num_batches + 1):
-        trace_window.maybe_start(i, timeline.fetcher)
-        state, metrics = train_step(state, next(batch_iter),
-                                    jax.random.fold_in(rng, warmup_steps + i))
-        timeline.record(i, metrics["loss"])
-        if (cfg.train_dir and cfg.save_model_steps
-                and i % cfg.save_model_steps == 0 and i < cfg.num_batches):
-            # NOTE: saving fetches the full state — it syncs the device and
-            # perturbs the throughput measurement around this step
-            save_now(i)
-        trace_window.poll(timeline.fetcher.fetched_step)
+            if ckpt_mod.latest_step(cfg.train_dir) is None:
+                save_now(0)     # rewind baseline: the post-warmup state
+        for i in range(1, cfg.num_batches + 1):
+            # step boundary: honor preemption.  Single-host checks the
+            # local flag every step; multi-host runs the cross-host
+            # agreement at sync-window boundaries only — it is a
+            # collective and must execute at the same step everywhere.
+            if world == 1:
+                if preempt_h.requested():
+                    _emergency(i - 1)
+            elif ((i - 1) % timeline.sync_every == 0
+                    and preempt_h.agreed(world)):
+                _emergency(i - 1)
+            trace_window.maybe_start(i, timeline.fetcher)
+            batch = next(batch_iter)
+            if plan is not None:
+                plan.fire_step_faults(i, print_fn, obs_writer)
+                batch = plan.poison_batch(i, batch, print_fn, obs_writer)
+            state, metrics = train_step(
+                state, batch, jax.random.fold_in(rng, warmup_steps + i))
+            timeline.record(i, metrics["loss"])
+            if tracker is not None:
+                tracker.update(metrics["nonfinite"])
+                if i % timeline.sync_every == 0 or i == cfg.num_batches:
+                    _poll_guard(i)
+            if (cfg.train_dir and cfg.save_model_steps
+                    and i % cfg.save_model_steps == 0
+                    and i < cfg.num_batches):
+                # NOTE: saving fetches the full state — it syncs the
+                # device and perturbs the throughput measurement around
+                # this step
+                if tracker is not None:
+                    # settle the guard first: under rewind the state may
+                    # carry un-detected poisoned updates mid-window, and
+                    # persisting them would make the poisoned checkpoint
+                    # the one rewind restores (the save syncs anyway, so
+                    # the extra poll is free)
+                    _poll_guard(i)
+                save_now(i)
+            trace_window.poll(timeline.fetcher.fetched_step)
+    except BaseException:
+        if dog is not None:
+            dog.stop()
+        raise
+    finally:
+        preempt_h.uninstall()
     losses: list[float] = []
+    nonfinite_display: list[int] = []
 
     def line(i: int, rate: float, v) -> None:
         loss = float(np.asarray(v))
         losses.append(loss)
+        if not np.isfinite(loss):
+            nonfinite_display.append(i)
         print_fn(f"{i}\t{units}/sec: {rate:.1f}\tloss: {loss:.3f}")
         obs_writer.event("window", step=i, rate=rate,
                          step_ms=1e3 * global_batch / rate, loss=loss)
 
-    total_time = timeline.finish(line)
+    try:
+        # the watchdog stays armed THROUGH the drain: up to max_inflight
+        # steps are still executing when the loop exits, and a collective
+        # that deadlocks in that tail would otherwise hang finish()
+        # forever with no stack dump (arrivals keep advancing during a
+        # healthy drain, so no false positive)
+        total_time = timeline.finish(line)
+    finally:
+        if dog is not None:
+            dog.stop()
     trace_window.stop()     # no-op if the in-loop poll already stopped it
+    if policy == "abort" and nonfinite_display:
+        # the default non-finite policy: fail the run loudly (the
+        # display-step losses the timeline already fetches are the
+        # zero-cost detector) instead of printing a NaN table and
+        # exiting 0 the way the reference would
+        obs_writer.event("nonfinite_abort", steps=nonfinite_display[:16])
+        obs_writer.close()
+        raise guards_mod.NonFiniteError(
+            f"non-finite loss at display step(s) "
+            f"{nonfinite_display[:16]} (--on_nonfinite=abort; use skip "
+            f"or rewind to survive, or inspect the data/lr)")
     if cfg.train_dir:
         save_now(cfg.num_batches)       # final state (tf_cnn train_dir)
     total_rate = cfg.num_batches * global_batch / total_time

@@ -40,6 +40,7 @@ from tpu_hc_bench.parallel.collectives import (
     allreduce_gradients, fused_psum_tree,
 )
 from tpu_hc_bench.parallel import fabric as fabric_mod
+from tpu_hc_bench.resilience import guards
 from tpu_hc_bench.topology import DATA_AXIS
 
 
@@ -210,6 +211,7 @@ def build_train_step(
     is_text = spec.is_text
     ctc = getattr(spec, "ctc", False)
     fuse = cfg.variable_update == "psum"
+    guard = guards.guard_mode(cfg)      # --on_nonfinite: off|flag|skip
     from tpu_hc_bench.topology import DCN_AXIS, SEQ_AXIS as _SEQ
 
     # a bound seq axis (any size — size 1 is the degenerate-SP mode)
@@ -405,6 +407,17 @@ def build_train_step(
             batch_stats=new_stats,
             opt_state=new_opt,
         )
+        if guard != "off":
+            # --on_nonfinite: in-step non-finite detection on loss AND the
+            # (post-allreduce) grad global norm; "skip" drops the update
+            # with a select INSIDE this compiled program — the only
+            # donation-safe spelling, since the input state's buffers are
+            # donated to this step (resilience/guards.py)
+            ok = guards.finite_flag(loss, grads)
+            if guard == "skip":
+                new_state = guards.select_state(ok, new_state, state)
+            return new_state, {"loss": loss,
+                               "nonfinite": guards.nonfinite_metric(ok)}
         return new_state, {"loss": loss}
 
     if cfg.forward_only:
@@ -461,6 +474,7 @@ def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
     batch (sync-BN) rather than per-worker — the one observable difference
     from the Horovod-semantics psum path, inherent to GSPMD.
     """
+    guard = guards.guard_mode(cfg)      # --on_nonfinite: off|flag|skip
 
     def step_fn(state: TrainState, batch, dropout_rng):
         if cfg.forward_only:
@@ -484,6 +498,13 @@ def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
             batch_stats=new_stats,
             opt_state=new_opt,
         )
+        if guard != "off":
+            # same in-step guard as the psum arm (donation-safe select)
+            ok = guards.finite_flag(loss, grads)
+            if guard == "skip":
+                new_state = guards.select_state(ok, new_state, state)
+            return new_state, {"loss": loss,
+                               "nonfinite": guards.nonfinite_metric(ok)}
         return new_state, {"loss": loss}
 
     if follow_inputs:

@@ -7,11 +7,26 @@ one Orbax PyTree checkpoint per step under ``<dir>/step_<n>``, with
 ``latest_step`` discovery for resume.  Only array/step state is saved;
 ``apply_fn``/``tx`` are reconstructed from config at restore (standard JAX
 practice — function objects don't serialize).
+
+Crash-safe commit protocol (round 8): a save writes into
+``step_<n>.tmp``, then renames to ``step_<n>``, then drops a
+``step_<n>.complete`` sentinel next to the directory.  Discovery
+(``latest_step``/``complete_steps``) only believes sentineled steps, so
+a crash mid-save leaves either an ignored ``.tmp`` or an ignored
+sentinel-less directory — never a "latest" checkpoint that ``restore``
+then chokes on; ``restore(step=None)`` therefore falls back to the
+newest *complete* step automatically.  ``gc_checkpoints`` is the
+``--keep_checkpoints=N`` retention pass (newest N complete steps
+survive; stale ``.tmp``/sentinel-less debris is reaped).
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 import re
+import shutil
+import time
 from pathlib import Path
 
 import jax
@@ -20,9 +35,78 @@ import orbax.checkpoint as ocp
 
 from tpu_hc_bench.train.step import TrainState
 
+_STEP_RE = re.compile(r"step_(\d+)")
+
 
 def _step_dir(base: Path, step: int) -> Path:
     return base / f"step_{step:08d}"
+
+
+def _marker(base: Path, step: int) -> Path:
+    """The completion sentinel: ``step_<n>.complete`` NEXT TO the step
+    directory (inside it would pollute the Orbax tree)."""
+    return base / f"step_{step:08d}.complete"
+
+
+def _fsync_path(path: Path) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass    # not every filesystem supports directory fsync
+
+
+def _marker_id(marker: Path) -> tuple | None:
+    """Identity of an existing sentinel file (None when absent) — a
+    fresh commit rewrites the file, so (inode, mtime_ns) distinguishes
+    the new sentinel from a stale one left by an earlier save of the
+    SAME step (a rewound/resumed run re-saving its restore point)."""
+    try:
+        st = marker.stat()
+    except OSError:
+        return None
+    return (st.st_ino, st.st_mtime_ns)
+
+
+def _commit_step_dir(base: Path, step: int, tmp: Path,
+                     stale_id: tuple | None = None) -> Path:
+    """tmp dir -> final dir -> sentinel, each durably ordered.
+
+    The prior sentinel (if any) is only touched HERE, after the full
+    Orbax write landed in ``tmp`` — a crash during the long write
+    leaves the previous complete checkpoint fully intact and
+    discoverable.  Multi-process: Orbax has already barriered all
+    writers inside ``save``; process 0 performs the single
+    retract+rename+sentinel, the others wait for a sentinel *different
+    from* ``stale_id`` (captured before the save) to appear on the
+    shared filesystem, so a stale marker never releases them early.
+    """
+    final = _step_dir(base, step)
+    marker = _marker(base, step)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        deadline = time.monotonic() + 60.0
+        while _marker_id(marker) in (None, stale_id):
+            if time.monotonic() > deadline:
+                raise OSError(
+                    f"checkpoint commit sentinel {marker} never appeared "
+                    "(is --train_dir on a filesystem shared by all "
+                    "hosts?)")
+            time.sleep(0.05)
+        return final
+    _fsync_path(tmp)
+    marker.unlink(missing_ok=True)
+    if final.exists():
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+    with open(marker, "w") as f:
+        f.write("ok\n")
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_path(base)
+    return final
 
 
 def save(state: TrainState, directory: str | Path,
@@ -38,7 +122,8 @@ def save(state: TrainState, directory: str | Path,
     base = Path(directory)
     base.mkdir(parents=True, exist_ok=True)
     step = int(jax.device_get(state.step))
-    path = _step_dir(base, step)
+    tmp = base / (_step_dir(base, step).name + ".tmp")
+    stale_id = _marker_id(_marker(base, step))
     pull = (lambda t: t) if sharded else jax.device_get
     payload = {
         "step": np.asarray(step),
@@ -47,20 +132,83 @@ def save(state: TrainState, directory: str | Path,
         "opt_state": pull(state.opt_state),
     }
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path.resolve(), payload, force=True)
-    return path
+    ckptr.save(tmp.resolve(), payload, force=True)
+    return _commit_step_dir(base, step, tmp, stale_id)
+
+
+def complete_steps(directory: str | Path) -> list[int]:
+    """Ascending step numbers whose commit sentinel exists — the only
+    checkpoints discovery believes (``.tmp`` and sentinel-less dirs are
+    crashed saves).  A checkpoint written before the sentinel scheme can
+    be adopted by hand: ``touch <dir>/step_NNNNNNNN.complete`` after
+    verifying the directory restores (the driver warns when it finds
+    only sentinel-less step dirs rather than silently reinitializing)."""
+    base = Path(directory)
+    if not base.exists():
+        return []
+    return sorted(
+        int(m.group(1))
+        for p in base.iterdir()
+        if p.is_dir()
+        and (m := _STEP_RE.fullmatch(p.name))
+        and _marker(base, int(m.group(1))).exists()
+    )
 
 
 def latest_step(directory: str | Path) -> int | None:
+    steps = complete_steps(directory)
+    return steps[-1] if steps else None
+
+
+def gc_checkpoints(directory: str | Path, keep: int,
+                   print_fn=None) -> list[int]:
+    """--keep_checkpoints retention: keep the newest ``keep`` complete
+    steps, delete the rest plus stale ``.tmp`` partial writes.  Returns
+    the deleted step numbers.  Multi-process: process 0 only
+    (single-writer, same shared filesystem the saves use).
+
+    Sentinel-less final-name step dirs are deliberately LEFT ALONE:
+    they are either crashed renames (rare, small) or checkpoints
+    written before the sentinel scheme — deleting a legacy checkpoint
+    as "debris" would be data loss (adopt one instead, see
+    ``complete_steps``).
+    """
+    if keep <= 0:
+        return []
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return []
     base = Path(directory)
-    if not base.exists():
-        return None
-    steps = [
-        int(m.group(1))
-        for p in base.iterdir()
-        if (m := re.fullmatch(r"step_(\d+)", p.name))
-    ]
-    return max(steps) if steps else None
+    steps = complete_steps(base)
+    doomed = steps[:-keep]
+    for step in doomed:
+        # sentinel first: a crash mid-delete must not leave a sentinel
+        # pointing at a half-deleted directory
+        _marker(base, step).unlink(missing_ok=True)
+        shutil.rmtree(_step_dir(base, step), ignore_errors=True)
+    for p in base.glob("step_*.tmp"):
+        shutil.rmtree(p, ignore_errors=True)
+    if doomed and print_fn is not None:
+        print_fn(f"checkpoint GC: removed step(s) "
+                 f"{', '.join(str(s) for s in doomed)} "
+                 f"(--keep_checkpoints={keep})")
+    return doomed
+
+
+def fingerprint(tree) -> str:
+    """Order-deterministic digest of every array leaf's raw bytes.
+
+    The driver prints it at emergency save and at restore, so a
+    kill/resume round trip can assert bitwise-identical params from the
+    two log lines alone.  Requires fully-addressable arrays
+    (single-process or replicated state).
+    """
+    h = hashlib.blake2b(digest_size=10)
+    for leaf in jax.tree.leaves(jax.device_get(tree)):
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def restore(state: TrainState, directory: str | Path,
@@ -77,9 +225,16 @@ def restore(state: TrainState, directory: str | Path,
     """
     base = Path(directory)
     if step is None:
+        # falls back to the newest COMPLETE step: a crash mid-save left
+        # an ignored .tmp / sentinel-less dir, not a broken "latest"
         step = latest_step(base)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {base}")
+            raise FileNotFoundError(f"no complete checkpoints under {base}")
+    elif not _marker(base, step).exists():
+        raise FileNotFoundError(
+            f"checkpoint step {step} under {base} is incomplete (no "
+            f"{_marker(base, step).name} sentinel — crashed save?); "
+            f"complete steps: {complete_steps(base) or 'none'}")
     pull = (lambda t: t) if sharded else jax.device_get
     template = {
         "step": jax.device_get(state.step),
@@ -128,12 +283,13 @@ def save_pp(params, opt_state, step: int, directory: str | Path) -> Path:
     """
     base = Path(directory)
     base.mkdir(parents=True, exist_ok=True)
-    path = _step_dir(base, int(step))
+    tmp = base / (_step_dir(base, int(step)).name + ".tmp")
+    stale_id = _marker_id(_marker(base, int(step)))
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save((path / "pp_params").resolve(), params, force=True)
+    ckptr.save((tmp / "pp_params").resolve(), params, force=True)
     if opt_state is not None:
-        ckptr.save((path / "opt_state").resolve(), opt_state, force=True)
-    return path
+        ckptr.save((tmp / "opt_state").resolve(), opt_state, force=True)
+    return _commit_step_dir(base, int(step), tmp, stale_id)
 
 
 def restore_pp(params, opt_state, directory: str | Path,
@@ -149,9 +305,14 @@ def restore_pp(params, opt_state, directory: str | Path,
     """
     base = Path(directory)
     if step is None:
-        step = latest_step(base)
+        step = latest_step(base)    # newest COMPLETE step (see restore)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {base}")
+            raise FileNotFoundError(f"no complete checkpoints under {base}")
+    elif not _marker(base, step).exists():
+        raise FileNotFoundError(
+            f"checkpoint step {step} under {base} is incomplete (no "
+            f"{_marker(base, step).name} sentinel — crashed save?); "
+            f"complete steps: {complete_steps(base) or 'none'}")
     path = _step_dir(base, step)
 
     def args_of(tree):

@@ -52,3 +52,25 @@ def drain(tree):
     if probes:
         jax.device_get(probes)
     return tree
+
+
+def all_processes_any(flag: bool) -> bool:
+    """Cross-host agreement: True iff ANY process passed True.
+
+    The shared primitive for run-control decisions that must be
+    unanimous — e.g. "stop and checkpoint now" on preemption, where a
+    signal lands on one VM but a checkpoint written by half a mesh is
+    garbage.  Single-process: a plain bool.  Multi-process: a tiny
+    host-level allgather, so this is a COLLECTIVE — every process must
+    call it at the same point (the driver calls it at sync-window
+    boundaries, the same step everywhere).
+    """
+    import numpy as np
+
+    if jax.process_count() <= 1:
+        return bool(flag)
+    from jax.experimental import multihost_utils
+
+    votes = multihost_utils.process_allgather(
+        np.asarray([1 if flag else 0], np.int32))
+    return bool(np.max(votes))
