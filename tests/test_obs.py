@@ -282,9 +282,12 @@ def test_metrics_diff_flags_config_drift(tmp_path):
     assert "config: batch_size: 2 -> 4" in out.getvalue()
 
 
-def test_cli_rejects_nonexistent_artifact(tmp_path):
-    with pytest.raises(FileNotFoundError):
-        obs_main(["summarize", str(tmp_path / "nope")], out=io.StringIO())
+def test_cli_rejects_nonexistent_artifact(tmp_path, capsys):
+    # one clear line + exit 2, not a traceback (the CLI meets operators
+    # mid-incident; tests/test_goodput.py covers the degraded-dir matrix)
+    rc = obs_main(["summarize", str(tmp_path / "nope")], out=io.StringIO())
+    assert rc == 2
+    assert capsys.readouterr().err.startswith("error:")
 
 
 def test_writer_disabled_paths(tmp_path):

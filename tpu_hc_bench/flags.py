@@ -173,6 +173,14 @@ class BenchmarkConfig:
     metrics_dir: str | None = None            # per-run observability artifact:
                                               # metrics.jsonl + manifest.json
                                               # (obs.metrics; worker 0 writes)
+                                              # + per-host heartbeat files
+                                              # metrics.<k>.jsonl (obs.fleet;
+                                              # every process writes its own)
+    fabric_ceiling: str | None = None         # measured-fabric sweep JSON
+                                              # (microbench.osu --json): the
+                                              # run judges its achieved
+                                              # collective bandwidth against
+                                              # this ceiling (obs.efficiency)
     num_slices: int = 0                       # fabric=dcn multislice layout:
                                               # slices x hosts/slice x chips
                                               # (0 = one slice per host)
@@ -359,6 +367,9 @@ class BenchmarkConfig:
                     "--profile_steps applies to the timed training loop; "
                     "it has no meaning under --eval")
             parse_profile_steps(self.profile_steps)  # loud format check
+        # --fabric_ceiling is validated at RUN start (driver loads the
+        # sweep before the banner): resolve() stays filesystem-pure so
+        # configs parse on machines that don't hold the artifacts
         if self.model_parallel > 1 and self.expert_parallel > 1:
             raise ValueError(
                 "--model_parallel and --expert_parallel are exclusive: both "
@@ -672,6 +683,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile_steps", type=str, default=None,
                    metavar="A:B")
     p.add_argument("--metrics_dir", type=str, default=None)
+    p.add_argument("--fabric_ceiling", type=str, default=None,
+                   metavar="SWEEP_JSON")
     p.add_argument("--num_slices", type=int, default=d.num_slices)
     p.add_argument("--fused_conv", type=_parse_bool, default=d.fused_conv)
     p.add_argument("--fused_xent", type=_parse_bool, default=False)

@@ -118,6 +118,13 @@ def main(argv: list[str] | None = None) -> int:
         )
         if result.total_images_per_sec <= 0:
             rc = resilience.EXIT_ZERO_THROUGHPUT
+        if cfg.metrics_dir:
+            # the operator's next command, spelled out (goodput/MFU/
+            # straggler/ceiling lines all render from the artifacts)
+            tee("summarize: python -m tpu_hc_bench.obs summarize "
+                + cfg.metrics_dir
+                + (f" --fabric_ceiling {cfg.fabric_ceiling}"
+                   if cfg.fabric_ceiling else ""))
     except resilience.PreemptedError as e:
         # graceful preemption: the emergency checkpoint is on disk (when
         # --train_dir is set) — exit EXIT_PREEMPTED so the relauncher

@@ -181,6 +181,30 @@ def format_table(results: list[SweepResult]) -> str:
     return "\n".join(lines)
 
 
+def sweep_json(results_by_op: dict[str, list[SweepResult]]) -> dict:
+    """The JSON export schema ``obs.efficiency.load_fabric_ceiling``
+    consumes: one sweep-row list per op plus the fabric identity the
+    ceiling is only valid for (world size, device kind)."""
+    from tpu_hc_bench.utils import hw
+
+    world = next(
+        (rs[0].world_size for rs in results_by_op.values() if rs), 0)
+    try:
+        kind = hw.device_kind()
+    except Exception:
+        kind = "unknown"
+    return {
+        "schema": 1,
+        "created_unix": time.time(),
+        "world_size": world,
+        "device_kind": kind,
+        "sweeps": {
+            op: [dataclasses.asdict(r) for r in rows]
+            for op, rows in results_by_op.items()
+        },
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--op", choices=list(OSU_OPS) + ["all"], default="allreduce")
@@ -188,14 +212,26 @@ def main(argv=None):
     p.add_argument("--max_bytes", type=int, default=64 * 1024 * 1024)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--json", metavar="PATH", default=None,
+                   help="save the sweep as a fabric-ceiling file for "
+                        "--fabric_ceiling / obs summarize")
     args = p.parse_args(argv)
     ops = OSU_OPS if args.op == "all" else [args.op]
+    by_op: dict[str, list[SweepResult]] = {}
     for op in ops:
         res = run_sweep(
             op=op, min_bytes=args.min_bytes, max_bytes=args.max_bytes,
             warmup=args.warmup, iters=args.iters,
         )
+        by_op[op] = res
         print(format_table(res))
+    if args.json:
+        import json
+
+        with open(args.json, "w") as f:
+            json.dump(sweep_json(by_op), f, indent=2)
+            f.write("\n")
+        print(f"# sweep saved: {args.json} (pass as --fabric_ceiling)")
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""Runtime observability: trace analysis, run metrics, summarize/diff.
+"""Runtime observability: traces, metrics, goodput, fleet, efficiency.
 
 The runtime counterpart of the static ``tpu_hc_bench.analysis`` package.
 Where ``analysis`` inspects the *compiled program* (HLO, jaxpr),
@@ -14,11 +14,23 @@ Where ``analysis`` inspects the *compiled program* (HLO, jaxpr),
   windowed measurements plus a ``manifest.json`` (resolved flags, mesh
   shape, world size, versions, git sha) written next to it, so every
   benchmark run leaves something machine-readable behind.
+- ``obs.goodput`` — the wall-clock ledger: driver phase transitions
+  (init/compile/step/data_wait/checkpoint/rewind_replay/...) folded,
+  with resilience events counted as wasted work, into a goodput
+  fraction and per-category breakdown.
+- ``obs.fleet`` — per-host heartbeat files (``metrics.<k>.jsonl``,
+  every process writes its own) and clock-free straggler skew from a
+  sync-window progress allgather.
+- ``obs.efficiency`` — measured MFU (``compiled.cost_analysis()`` of
+  the actual step program, source-labeled against the analytic table)
+  and achieved-collective-bandwidth attribution against a measured
+  fabric ceiling (``microbench.osu --json`` sweeps).
 - ``python -m tpu_hc_bench.obs`` — ``summarize`` renders either
-  artifact kind (a metrics run or a raw trace directory);
-  ``diff`` compares two runs at bucket/metric granularity, so a
-  regression reads "collective +40%, compute flat" instead of a single
-  throughput delta.
+  artifact kind (a metrics run or a raw trace directory); ``diff``
+  compares two runs at bucket/metric granularity, so a regression
+  reads "collective +40%, compute flat" instead of a single throughput
+  delta; ``watch`` tails a live run in place and exits when it
+  completes.
 """
 
 from tpu_hc_bench.obs import metrics, trace  # noqa: F401

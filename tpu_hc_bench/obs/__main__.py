@@ -1,9 +1,13 @@
-"""CLI: ``python -m tpu_hc_bench.obs`` — summarize / diff run artifacts.
+"""CLI: ``python -m tpu_hc_bench.obs`` — summarize / diff / watch runs.
 
 Examples::
 
     # render a metrics run (dir with metrics.jsonl + manifest.json)
     python -m tpu_hc_bench.obs summarize /runs/r50_bs128
+
+    # ... judging collective bandwidth against a measured fabric sweep
+    python -m tpu_hc_bench.obs summarize /runs/r50_bs128 \
+        --fabric_ceiling /runs/osu_sweep.json
 
     # render a raw jax.profiler trace directory
     python -m tpu_hc_bench.obs summarize /tmp/vit_trace_vit_b16_64
@@ -12,14 +16,24 @@ Examples::
     # "collective +40%, compute flat" instead of one throughput delta
     python -m tpu_hc_bench.obs diff /runs/before /runs/after
 
-Both subcommands are pure file operations — no jax backend is touched,
-so artifacts copied off a TPU VM diff fine on a laptop.
+    # live tail of a running (or finished) benchmark
+    python -m tpu_hc_bench.obs watch /runs/r50_bs128
+
+All subcommands are pure file operations — no jax backend is touched,
+so artifacts copied off a TPU VM render fine on a laptop.
+
+Exit codes: 0 clean; 1 degraded run dir (rendered what survived — a
+missing manifest.json or a truncated jsonl tail, each reported as one
+WARNING line on stderr) or ``watch --timeout`` expiry; 2 unusable
+input (no metrics stream/trace at the path — one-line error, no
+traceback).
 """
 
 from __future__ import annotations
 
 import argparse
 import glob
+import json
 import os
 import sys
 
@@ -44,12 +58,29 @@ def _kind(path: str) -> str:
         "nor a trace dir (no *.trace.json.gz)")
 
 
-def _summarize(path: str, out) -> int:
+def _report_problems(problems: list[str]) -> int:
+    for p in problems:
+        print(f"WARNING: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+def _summarize(path: str, out, fabric_ceiling: str | None = None) -> int:
     if _kind(path) == "metrics":
-        lines = metrics_mod.summarize_run(path)
-    else:
-        summary = trace_mod.summarize_trace_dir(path)
-        lines = trace_mod.format_summary(summary, title=f"trace {path}")
+        problems: list[str] = []
+        lines = metrics_mod.summarize_run(path, fabric_ceiling=fabric_ceiling,
+                                          problems=problems)
+        print("\n".join(lines), file=out)
+        return _report_problems(problems)
+    summary = trace_mod.summarize_trace_dir(path)
+    lines = trace_mod.format_summary(summary, title=f"trace {path}")
+    if fabric_ceiling:
+        # never drop a flag silently: ceiling attribution needs the
+        # metrics run's step times and byte accounting, which a raw
+        # trace dir does not carry
+        lines.append(
+            "fabric ceiling: --fabric_ceiling applies to metrics runs "
+            "(needs wall step times + allreduce bytes); pass the "
+            "--metrics_dir artifact instead of the raw trace dir")
     print("\n".join(lines), file=out)
     return 0
 
@@ -61,12 +92,14 @@ def _diff(path_a: str, path_b: str, out) -> int:
               file=sys.stderr)
         return 2
     if kind_a == "metrics":
-        lines = metrics_mod.diff_runs(path_a, path_b)
-    else:
-        a = trace_mod.summarize_trace_dir(path_a)
-        b = trace_mod.summarize_trace_dir(path_b)
-        lines = [f"trace diff: {path_a} -> {path_b}"]
-        lines.extend(trace_mod.diff_buckets(a.totals, b.totals))
+        problems: list[str] = []
+        lines = metrics_mod.diff_runs(path_a, path_b, problems=problems)
+        print("\n".join(lines), file=out)
+        return _report_problems(problems)
+    a = trace_mod.summarize_trace_dir(path_a)
+    b = trace_mod.summarize_trace_dir(path_b)
+    lines = [f"trace diff: {path_a} -> {path_b}"]
+    lines.extend(trace_mod.diff_buckets(a.totals, b.totals))
     print("\n".join(lines), file=out)
     return 0
 
@@ -74,21 +107,50 @@ def _diff(path_a: str, path_b: str, out) -> int:
 def main(argv: list[str] | None = None, out=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpu_hc_bench.obs",
-        description="summarize/diff benchmark-run artifacts "
+        description="summarize/diff/watch benchmark-run artifacts "
                     "(metrics runs or jax.profiler trace dirs)")
     sub = ap.add_subparsers(dest="cmd", required=True)
     s = sub.add_parser("summarize",
                        help="render one run (metrics dir/jsonl or trace dir)")
     s.add_argument("path")
+    s.add_argument("--fabric_ceiling", metavar="SWEEP_JSON", default=None,
+                   help="osu sweep export (microbench.osu --json): adds "
+                        "per-collective %%-of-measured-ceiling lines")
     d = sub.add_parser("diff",
                        help="per-bucket/per-metric deltas between two runs")
     d.add_argument("run_a")
     d.add_argument("run_b")
+    w = sub.add_parser("watch",
+                       help="live tail: step rate, goodput, MFU, last "
+                            "resilience event; exits when the run does")
+    w.add_argument("path")
+    w.add_argument("--interval", type=float, default=1.0,
+                   help="poll/refresh period, seconds (default 1)")
+    w.add_argument("--timeout", type=float, default=None,
+                   help="give up (exit 1) after this many seconds")
+    w.add_argument("--no-follow", dest="follow", action="store_false",
+                   help="render one snapshot and exit")
     args = ap.parse_args(argv)
     out = out or sys.stdout
-    if args.cmd == "summarize":
-        return _summarize(args.path, out)
-    return _diff(args.run_a, args.run_b, out)
+    try:
+        if args.cmd == "summarize":
+            return _summarize(args.path, out,
+                              fabric_ceiling=args.fabric_ceiling)
+        if args.cmd == "diff":
+            return _diff(args.run_a, args.run_b, out)
+        from tpu_hc_bench.obs import watch as watch_mod
+
+        return watch_mod.watch(args.path, out=out, interval=args.interval,
+                               timeout_s=args.timeout, follow=args.follow)
+    except (FileNotFoundError, json.JSONDecodeError, ValueError,
+            RuntimeError) as e:
+        # a missing/garbage artifact gets ONE clear line and a distinct
+        # exit code, not a traceback — this CLI meets operators mid-
+        # incident, exactly when run dirs are least likely to be whole
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except KeyboardInterrupt:
+        return 130
 
 
 if __name__ == "__main__":

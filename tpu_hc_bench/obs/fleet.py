@@ -1,0 +1,231 @@
+"""Fleet-wide per-host visibility: heartbeats + straggler skew.
+
+The main ``metrics.jsonl`` is written by process 0 only (its records
+are globally aggregated — ``obs.metrics`` docstring), which means a
+fleet where one host is quietly 2 steps behind every sync window looks
+identical to a healthy one.  Two mechanisms close that gap:
+
+- **Heartbeats**: every process appends one compact record per sync
+  window to its *own* ``metrics.<process_index>.jsonl`` next to the
+  main stream — host id, last completed step, a step-duration EWMA,
+  and the local devices' memory stats.  Pure appends, no coordination,
+  so a wedged host's file simply stops growing (itself a signal).
+
+- **Straggler skew**: per-host wall clocks cannot be compared (no
+  trust in NTP on a preemptible fleet), so the skew measurement rides
+  a collective instead: at a sync-window boundary every process
+  contributes its last *completed* step to a host-level allgather.
+  The collective itself is the common time reference — every value is
+  sampled at the same program point — so ``max - median`` of the
+  gathered steps is a clock-free lag measure, converted to
+  milliseconds by the median host's step EWMA.  Process 0 writes the
+  result as a ``straggler`` record into the main stream.
+
+``read_heartbeats`` / ``straggler_lines`` are pure file operations so
+``summarize`` renders fleet state from artifacts on any machine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+_HEARTBEAT_RE = re.compile(r"^metrics\.(\d+)\.jsonl$")
+
+
+def heartbeat_path(out_dir: str, process_index: int) -> str:
+    return os.path.join(out_dir, f"metrics.{process_index}.jsonl")
+
+
+class StepEwma:
+    """Step-duration EWMA from (step, wall-time) samples at sync windows.
+
+    ``update`` returns the current EWMA in milliseconds (0.0 until two
+    samples exist).  Smoothing favors recency (alpha 0.3) so a host
+    that *becomes* slow shows up within a few windows.
+    """
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self._last: tuple[int, float] | None = None
+        self.ewma_ms = 0.0
+
+    def update(self, step: int, now: float | None = None) -> float:
+        now = time.monotonic() if now is None else now
+        if self._last is not None:
+            last_step, last_t = self._last
+            dsteps = step - last_step
+            if dsteps > 0:
+                sample_ms = 1e3 * (now - last_t) / dsteps
+                self.ewma_ms = (sample_ms if self.ewma_ms == 0.0 else
+                                self.alpha * sample_ms
+                                + (1 - self.alpha) * self.ewma_ms)
+        self._last = (step, now)
+        return self.ewma_ms
+
+
+class FleetWriter:
+    """Append-only heartbeat stream for THIS process.
+
+    Unlike ``MetricsWriter`` every process writes (that is the point);
+    disabled (no-op) when ``out_dir`` is falsy.  Each heartbeat is
+    flushed immediately — the file must be readable while the run is
+    live, and a killed process must not lose its last sign of life.
+    """
+
+    def __init__(self, out_dir: str | None, process_index: int | None = None):
+        self._f = None
+        self.process_index = 0
+        if not out_dir:
+            return
+        if process_index is None:
+            import jax
+
+            process_index = jax.process_index()
+        self.process_index = process_index
+        os.makedirs(out_dir, exist_ok=True)
+        self._f = open(heartbeat_path(out_dir, process_index), "w")
+
+    @property
+    def enabled(self) -> bool:
+        return self._f is not None
+
+    def heartbeat(self, step: int, step_ewma_ms: float,
+                  mem: dict | None = None, **extra) -> None:
+        if self._f is None:
+            return
+        rec = {"kind": "heartbeat", "host": self.process_index,
+               "step": int(step), "step_ewma_ms": float(step_ewma_ms),
+               "t_unix": time.time()}
+        if mem:
+            peaks = [v.get("peak_bytes_in_use", 0) for v in mem.values()]
+            rec["peak_bytes_in_use"] = max(peaks, default=0)
+        rec.update(extra)
+        try:
+            self._f.write(json.dumps(rec, default=str) + "\n")
+            self._f.flush()
+        except OSError:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None      # heartbeats are telemetry, never fatal
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
+            self._f.close()
+            self._f = None
+
+
+def straggler_gather(step: int, ewma_ms: float) -> dict | None:
+    """The device-backed allgather of per-host progress (a COLLECTIVE:
+    every process must call at the same step).  Returns the straggler
+    record fields, or None when the gather is unavailable."""
+    import numpy as np
+
+    import jax
+
+    if jax.process_count() <= 1:
+        host_steps = [int(step)]
+        host_ewmas = [float(ewma_ms)]
+    else:
+        try:
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(
+                np.asarray([float(step), float(ewma_ms)], np.float64))
+            arr = np.asarray(gathered).reshape(jax.process_count(), 2)
+            host_steps = [int(s) for s in arr[:, 0]]
+            host_ewmas = [float(e) for e in arr[:, 1]]
+        except Exception:
+            return None
+    return compute_skew(host_steps, host_ewmas)
+
+
+def compute_skew(host_steps: list[int],
+                 host_ewmas: list[float]) -> dict:
+    """max - median host lag, in steps and (EWMA-scaled) milliseconds."""
+    import statistics
+
+    med = statistics.median(host_steps)
+    skew_steps = max(host_steps) - med
+    med_ewma = statistics.median(host_ewmas) if host_ewmas else 0.0
+    return {
+        "host_steps": host_steps,
+        "skew_steps": float(skew_steps),
+        "skew_ms": float(skew_steps) * med_ewma,
+        "median_step_ewma_ms": med_ewma,
+    }
+
+
+# ---------------------------------------------------------------------
+# reading (pure file ops)
+
+
+def read_heartbeats(run_dir: str) -> dict[int, list[dict]]:
+    """All hosts' heartbeat records, keyed by process index.  Corrupt
+    lines (a heartbeat interrupted by the very death it reports) are
+    skipped silently — partial fleet state beats none."""
+    out: dict[int, list[dict]] = {}
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return out
+    for name in sorted(names):
+        m = _HEARTBEAT_RE.match(name)
+        if not m:
+            continue
+        host = int(m.group(1))
+        recs = []
+        with open(os.path.join(run_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        out[host] = recs
+    return out
+
+
+def straggler_lines(run_dir: str, records: list[dict]) -> list[str]:
+    """Fleet lines for ``summarize``: the last in-stream ``straggler``
+    record (collective-sampled, clock-free) plus the per-host heartbeat
+    tail (last step each host reported, EWMA, time since last beat)."""
+    lines: list[str] = []
+    stragglers = [r for r in records if r.get("kind") == "straggler"]
+    if stragglers:
+        s = stragglers[-1]
+        lines.append(
+            f"  straggler skew: max-median {s.get('skew_steps', 0):.0f} "
+            f"step(s) (~{s.get('skew_ms', 0.0):.1f}ms) across "
+            f"{len(s.get('host_steps', []))} host(s) "
+            f"at step {s.get('step', '?')}")
+    beats = read_heartbeats(run_dir)
+    if beats:
+        last = {h: recs[-1] for h, recs in beats.items() if recs}
+        if last:
+            steps = [r.get("step", 0) for r in last.values()]
+            import statistics
+
+            med = statistics.median(steps)
+            lines.append(
+                f"  heartbeats: {len(last)} host file(s), last steps "
+                f"median {med:.0f} min {min(steps)} max {max(steps)}")
+            laggards = [(h, r) for h, r in sorted(last.items())
+                        if med - r.get("step", 0) >= 1]
+            for h, r in laggards[:4]:
+                lines.append(
+                    f"    host{h}: step {r.get('step')} "
+                    f"({med - r.get('step', 0):.0f} behind median, "
+                    f"ewma {r.get('step_ewma_ms', 0.0):.1f}ms)")
+    return lines

@@ -165,6 +165,8 @@ class MetricsWriter:
             with open(os.path.join(out_dir, MANIFEST_NAME), "w") as f:
                 json.dump(manifest, f, indent=2, default=str)
                 f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
         self._f = open(os.path.join(out_dir, METRICS_NAME), "w")
 
     @property
@@ -206,7 +208,17 @@ class MetricsWriter:
             self._f = None
 
     def close(self) -> None:
+        """Flush AND fsync before closing: the watchdog exit-70 and
+        preempt exit-75 paths call this as their very last act, and the
+        tail of the stream (the watchdog_dump/preempt record that
+        explains the death) must reach the disk, not just the page
+        cache, before the process is gone."""
         if self._f is not None:
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass        # closing a dying stream must never raise
             self._f.close()
             self._f = None
 
@@ -231,19 +243,40 @@ def resolve_run(path: str) -> tuple[str | None, str]:
     return (manifest if os.path.isfile(manifest) else None), metrics
 
 
-def read_run(path: str) -> tuple[dict, list[dict]]:
+def read_run(path: str,
+             problems: list[str] | None = None) -> tuple[dict, list[dict]]:
     """Load ``(manifest, records)`` for a run (manifest {} if absent).
 
-    Tolerant of corrupt lines (a write that failed mid-flush and was
-    retried leaves a terminated fragment behind): they are skipped with
-    a stderr warning instead of crashing the CLI on exactly the run
-    whose telemetry survived an I/O incident.
+    Tolerant of a degraded run dir — a missing manifest (the writer
+    died before its eager manifest landed, or only the jsonl was
+    copied), a corrupt manifest, or corrupt/truncated jsonl lines (a
+    write interrupted mid-flush, a process killed mid-append).  Each
+    degradation is reported as one clear line: appended to
+    ``problems`` when the caller passes a list (the CLI turns a
+    non-empty list into a nonzero exit), else written to stderr.
+    Raises ``FileNotFoundError`` only when there is no metrics stream
+    at all — then there is nothing to degrade to.
     """
+    def note(msg: str) -> None:
+        if problems is not None:
+            problems.append(msg)
+        else:
+            sys.stderr.write(f"WARNING: {msg}\n")
+
     manifest_path, metrics_path = resolve_run(path)
     manifest = {}
-    if manifest_path:
-        with open(manifest_path) as f:
-            manifest = json.load(f)
+    if manifest_path is None:
+        note(f"{os.path.dirname(metrics_path) or '.'}: no "
+             f"{MANIFEST_NAME} (crashed before the eager manifest "
+             f"write, or a partial copy?)")
+    else:
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            note(f"{manifest_path}: unreadable manifest ({e}); "
+                 f"rendering records without run identity")
+            manifest = {}
     records = []
     corrupt = 0
     with open(metrics_path) as f:
@@ -256,9 +289,8 @@ def read_run(path: str) -> tuple[dict, list[dict]]:
             except json.JSONDecodeError:
                 corrupt += 1
     if corrupt:
-        sys.stderr.write(
-            f"WARNING: {metrics_path}: skipped {corrupt} corrupt "
-            f"line(s) (interrupted write?)\n")
+        note(f"{metrics_path}: skipped {corrupt} corrupt/truncated "
+             f"line(s) (interrupted write?)")
     return manifest, records
 
 
@@ -280,9 +312,17 @@ def _last(records: list[dict], kind: str) -> dict | None:
     return recs[-1] if recs else None
 
 
-def summarize_run(path: str) -> list[str]:
-    """Render one metrics run as text lines."""
-    manifest, records = read_run(path)
+def summarize_run(path: str, fabric_ceiling: str | None = None,
+                  problems: list[str] | None = None) -> list[str]:
+    """Render one metrics run as text lines.
+
+    ``fabric_ceiling``: path to a ``microbench.osu --json`` sweep
+    export; when given, the achieved collective bandwidth (trace
+    buckets x wall step time x gradient bytes) is judged against the
+    sweep's measured peak.  ``problems`` collects degradation notices
+    (see ``read_run``).
+    """
+    manifest, records = read_run(path, problems=problems)
     lines = [f"run: {path}"]
     if manifest:
         mesh = manifest.get("mesh_shape")
@@ -313,6 +353,23 @@ def summarize_run(path: str) -> list[str]:
             f"p50 {summary.get('p50_step_ms', 0.0):.2f}ms"
             f" (granularity {summary.get('p50_step_granularity', '?')} "
             f"step)  MFU {100 * summary.get('mfu', 0.0):.1f}%")
+        from tpu_hc_bench.obs import efficiency as eff_mod
+
+        lines.extend(eff_mod.mfu_lines(summary))
+    # goodput ledger: fold the phase transitions + resilience events
+    # into the wall-clock account (runs predating the ledger render
+    # without it)
+    from tpu_hc_bench.obs import fleet as fleet_mod
+    from tpu_hc_bench.obs import goodput as goodput_mod
+
+    ledger = goodput_mod.build_ledger(records)
+    if ledger is not None:
+        lines.extend("  " + ln for ln in ledger.format_lines())
+    try:
+        run_dir = os.path.dirname(resolve_run(path)[1])
+        lines.extend(fleet_mod.straggler_lines(run_dir, records))
+    except FileNotFoundError:
+        pass
     data = _last(records, "data")
     if data:
         lines.append(
@@ -343,6 +400,12 @@ def summarize_run(path: str) -> list[str]:
                           for k, v in sorted(tb["buckets"].items(),
                                              key=lambda kv: -kv[1]))
         lines.append(f"  trace buckets: {parts}")
+    if fabric_ceiling:
+        from tpu_hc_bench.obs import efficiency as eff_mod
+
+        ceiling = eff_mod.load_fabric_ceiling(fabric_ceiling)
+        lines.extend(eff_mod.ceiling_utilization_lines(
+            summary or {}, tb, ceiling))
     return lines
 
 
@@ -352,13 +415,14 @@ def _pct(a: float, b: float) -> str:
     return "new" if b else "-"
 
 
-def diff_runs(path_a: str, path_b: str) -> list[str]:
+def diff_runs(path_a: str, path_b: str,
+              problems: list[str] | None = None) -> list[str]:
     """Compare two metrics runs: headline metrics, per-bucket trace
     deltas, and any resolved-flag differences."""
     from tpu_hc_bench.obs import trace as trace_mod
 
-    man_a, recs_a = read_run(path_a)
-    man_b, recs_b = read_run(path_b)
+    man_a, recs_a = read_run(path_a, problems=problems)
+    man_b, recs_b = read_run(path_b, problems=problems)
     lines = [f"diff: {path_a} -> {path_b}"]
 
     # resolved-flag drift: a perf delta with a config delta is not a
@@ -367,7 +431,8 @@ def diff_runs(path_a: str, path_b: str) -> list[str]:
     # necessarily write to different paths (noise on every diff), but
     # set-vs-unset IS behavioral drift (checkpoint saves sync the
     # device, profiling perturbs the window)
-    path_flags = {"metrics_dir", "trace_dir", "train_dir"}
+    path_flags = {"metrics_dir", "trace_dir", "train_dir",
+                  "fabric_ceiling"}
     cfg_a, cfg_b = man_a.get("config", {}), man_b.get("config", {})
 
     def _cmp(cfg, k):
@@ -391,6 +456,7 @@ def diff_runs(path_a: str, path_b: str) -> list[str]:
         ("mean step ms", "mean_step_ms"),
         ("p50 step ms", "p50_step_ms"),
         ("mfu", "mfu"),
+        ("goodput", "goodput"),
         ("final loss", "final_loss"),
     )
     lines.append(f"  {'metric':>14s} {'a':>12s} {'b':>12s} {'delta':>8s}")
@@ -400,6 +466,13 @@ def diff_runs(path_a: str, path_b: str) -> list[str]:
         va, vb = sum_a.get(key, 0.0), sum_b.get(key, 0.0)
         lines.append(f"  {label:>14s} {va:12.4g} {vb:12.4g} "
                      f"{_pct(va, vb):>8s}")
+    src_a = sum_a.get("mfu_source")
+    src_b = sum_b.get("mfu_source")
+    if (src_a or src_b) and src_a != src_b:
+        # measured-vs-analytic MFUs are different quantities; say so
+        # before anyone reads the delta row as a regression
+        lines.append(f"  note: MFU flops source differs: "
+                     f"{src_a or '?'} -> {src_b or '?'}")
 
     tb_a = _last(recs_a, "trace_buckets")
     tb_b = _last(recs_b, "trace_buckets")

@@ -155,6 +155,12 @@ class Watchdog:
             try:
                 self._obs.event("watchdog_dump", age_s=age,
                                 timeout_s=self.timeout_s)
+                # terminate the goodput ledger so the wedged span is
+                # accounted (obs.goodput), then flush+fsync — close()
+                # is this stream's durability guarantee and the very
+                # next thing is os._exit
+                self._obs.event("phase", phase="end", t=time.monotonic(),
+                                step=None, reason="watchdog")
                 self._obs.close()
             except Exception:
                 pass

@@ -21,6 +21,9 @@ import numpy as np
 
 from tpu_hc_bench import flags as flags_mod
 from tpu_hc_bench.flags import BenchmarkConfig
+from tpu_hc_bench.obs import efficiency as obs_efficiency
+from tpu_hc_bench.obs import fleet as obs_fleet
+from tpu_hc_bench.obs import goodput as obs_goodput
 from tpu_hc_bench.obs import metrics as obs_metrics
 from tpu_hc_bench.models import create_model
 from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
@@ -58,6 +61,13 @@ class BenchmarkResult:
     mfu: float
     final_loss: float
     fabric: str
+    # wall-clock goodput fraction (obs.goodput ledger): productive step
+    # seconds / wall seconds; NaN where no ledger ran (eval, PP arms)
+    goodput: float = float("nan")
+    # where the MFU's FLOP figure came from: "measured" =
+    # compiled.cost_analysis() of the actual step program, "analytic" =
+    # the hand-maintained spec.flops_per_example table (obs.efficiency)
+    mfu_source: str = "analytic"
 
     def json_line(self) -> dict:
         return dataclasses.asdict(self)
@@ -370,10 +380,10 @@ class _TraceWindow:
         self.active = False
         self.print_fn(f"profiler trace written to {self.trace_dir}")
 
-    def post_summary(self) -> dict[str, float] | None:
+    def post_summary(self):
         """Print the bucket attribution of the trace just written
         (through the shared ``obs.trace`` formatter) and return the
-        per-bucket totals, or None when no usable trace exists (e.g. a
+        ``TraceSummary``, or None when no usable trace exists (e.g. a
         CPU run: the profiler writes host tracks only)."""
         if self.trace_dir is not None and not self.started:
             # the user asked for a trace and never got one — say so
@@ -394,7 +404,7 @@ class _TraceWindow:
             return None
         for line in obs_trace.format_summary(summary):
             self.print_fn(line)
-        return summary.totals
+        return summary
 
 
 def _fingerprint_line(params, print_fn) -> None:
@@ -584,6 +594,10 @@ def run_benchmark(
     import jax.numpy as jnp
 
     fab = fabric_mod.resolve_fabric(fabric_name)
+    # load the fabric-ceiling sweep NOW, loudly: a typo'd path must die
+    # before warmup, not after the full run when the summary needs it
+    fabric_ceiling = (obs_efficiency.load_fabric_ceiling(cfg.fabric_ceiling)
+                      if cfg.fabric_ceiling else None)
     layout = layout or discover_layout()
     # TP/EP claim the mesh's "model" axis, PP "pipe", SP "seq".  Round 2:
     # minor axes COMPOSE — DPxPPxTP and DPxSPxTP are the supported 3-D
@@ -797,9 +811,14 @@ def run_benchmark(
                                      fabric=fab.value),
             primary=True)
         print_fn(f"metrics: {cfg.metrics_dir}/{obs_metrics.METRICS_NAME} "
-                 f"(+ {obs_metrics.MANIFEST_NAME})")
+                 f"(+ {obs_metrics.MANIFEST_NAME}); live view: "
+                 f"python -m tpu_hc_bench.obs watch {cfg.metrics_dir}")
     else:
         obs_writer = obs_metrics.MetricsWriter(None)
+    # goodput ledger (obs.goodput): phase transitions into the metrics
+    # stream + a local mirror so the final account never re-reads the
+    # file; enters "init" now
+    phases = obs_goodput.PhaseTracker(obs_writer)
 
     # --- data ---
     if cfg.data_dir is not None and not spec.is_text:
@@ -1144,12 +1163,21 @@ def run_benchmark(
         train_step = step_mod.build_train_step(mesh, cfg, spec, fab)
     rng = jax.random.PRNGKey(cfg.seed + 17)
 
+    # per-host heartbeat stream (obs.fleet): EVERY process writes its
+    # own metrics.<process_index>.jsonl — per-host visibility is the
+    # point, so this is deliberately not primary-gated like the main
+    # stream.  Train loop only (created after the eval arms return).
+    fleet_writer = obs_fleet.FleetWriter(cfg.metrics_dir)
+
     # --- warmup (includes compile; reference warmup=50, :32) ---
     # rng is folded with the step counter so dropout masks differ per step
+    phases.enter("compile")
     t_compile = time.perf_counter()
     metrics = None
+    warm_batch = None
     for w in range(max(1, cfg.num_warmup_batches)):
-        state, metrics = train_step(state, next(batch_iter),
+        warm_batch = next(batch_iter)
+        state, metrics = train_step(state, warm_batch,
                                     jax.random.fold_in(rng, w))
     drain(metrics["loss"])
     warmup_elapsed = time.perf_counter() - t_compile
@@ -1157,6 +1185,20 @@ def run_benchmark(
         f"warmup done: {cfg.num_warmup_batches} steps in "
         f"{warmup_elapsed:.1f}s (includes compile)"
     )
+
+    # measured FLOPs (obs.efficiency): AOT-lower the very step program
+    # and ask XLA's cost analysis — the honest MFU numerator.  Only on
+    # observability-enabled runs: the extra compile is wasted wall on a
+    # bare benchmark run (and still lands inside the ledger's "compile"
+    # phase here, before the timed loop starts).
+    measured_flops = None
+    if obs_writer.enabled or cfg.fabric_ceiling:
+        measured_flops = obs_efficiency.measured_step_flops(
+            train_step, state, warm_batch, rng)
+    # drop the reference NOW: the probe only needed shapes, and holding
+    # the last warmup batch through the timed run would pin one extra
+    # device batch in HBM (max_inflight exists because batch HBM matters)
+    warm_batch = None
 
     # --- timed loop (reference num_batches=100, display_every=10) ---
     # Fully asynchronous dispatch: the main thread never syncs, so the
@@ -1173,6 +1215,8 @@ def run_benchmark(
     # (run-tf-sing-libfabric-intelmpi.sh:98)
     trace_window = _TraceWindow(cfg, print_fn, timeline.sync_every)
     timeline.start(metrics["loss"])
+    phases.enter("step")
+    hb_ewma = obs_fleet.StepEwma()
     warmup_steps = max(1, cfg.num_warmup_batches)
 
     # --- resilience runtime (round 8): fault-injection plan, preemption
@@ -1184,13 +1228,21 @@ def run_benchmark(
     policy = cfg.on_nonfinite
     tracker = (guards_mod.GuardTracker()
                if policy in ("skip", "rewind") else None)
+    rewind_base_step = 0
+    if policy == "rewind":
+        # the absolute step counter at this RUN's start (nonzero on
+        # --resume runs): rewind waste accounting must place checkpoint
+        # stamps relative to this run's timed loop, not step 0 (the
+        # post-warmup fetch is one tiny scalar, after the drain)
+        rewind_base_step = (int(np.asarray(jax.device_get(state.step)))
+                            - warmup_steps)
     world = jax.process_count()
     preempt_h = preempt_mod.PreemptionHandler(print_fn=print_fn).install()
     timeout_s = watchdog_mod.resolve_timeout(
         cfg.step_timeout_s, warmup_elapsed / warmup_steps)
     dog = None
 
-    def save_now(i: int) -> None:
+    def save_now(i: int, phase: str = "checkpoint") -> None:
         def _do() -> None:
             if plan is not None:
                 plan.maybe_io_error("ckpt")
@@ -1216,6 +1268,7 @@ def run_benchmark(
         # legitimately — the watchdog must not count it as a hang
         if dog is not None:
             dog.pause()
+        phases.enter(phase, step=i)
         try:
             # multi-host saves are COLLECTIVE (Orbax barriers + the
             # commit-sentinel wait): a one-sided retry would leave the
@@ -1230,6 +1283,7 @@ def run_benchmark(
                                         cfg.keep_checkpoints,
                                         print_fn=print_fn)
         finally:
+            phases.enter("step", step=i)
             if dog is not None:
                 dog.resume()
 
@@ -1239,6 +1293,7 @@ def run_benchmark(
         raised PreemptedError to EXIT_PREEMPTED)."""
         print_fn(f"preemption: stopping after timed step {completed} "
                  f"(signal {preempt_h.signum})")
+        phases.enter("emergency_save", step=completed)
         saved = bool(cfg.train_dir)
         if saved and tracker is not None:
             # settle the guard first: under rewind the state may carry
@@ -1250,7 +1305,7 @@ def run_benchmark(
                 saved = False   # budget died on poisoned state: keep it
                                 # off disk, exit preempted without a save
         if saved:
-            save_now(completed)
+            save_now(completed, phase="emergency_save")
             if not pp_native_ckpt:
                 _fingerprint_line(
                     state.params if hasattr(state, "params") else state[0],
@@ -1258,7 +1313,9 @@ def run_benchmark(
             obs_writer.event("emergency_ckpt", step=completed)
         obs_writer.event("preempt", step=completed,
                          signal=preempt_h.signum, checkpoint_saved=saved)
+        phases.end(step=completed)
         obs_writer.close()
+        fleet_writer.close()
         raise preempt_mod.PreemptedError(completed, saved, preempt_h.signum)
 
     guard_seen_total = 0
@@ -1288,11 +1345,16 @@ def run_benchmark(
                      f"total {total})")
             obs_writer.event("nonfinite_skip", step=i, new_bad=new_bad,
                              streak=streak, total=total)
+            # dropped updates burned step time whose work was discarded:
+            # the goodput ledger counts them against the run
+            phases.note_skipped_updates(new_bad)
             # budget on the PEAK streak: a consecutive run that ended
             # inside the window (streak already reset by a good step)
             # still counts
             if peak >= cfg.max_bad_steps:
+                phases.end(step=i)
                 obs_writer.close()
+                fleet_writer.close()
                 raise guards_mod.GuardBudgetError(
                     f"{peak} consecutive non-finite steps "
                     f"(--max_bad_steps={cfg.max_bad_steps})")
@@ -1303,12 +1365,15 @@ def run_benchmark(
         # max_bad_steps-th consecutive bad window.
         rewind_streak += 1
         if rewind_streak >= cfg.max_bad_steps:
+            phases.end(step=i)
             obs_writer.close()
+            fleet_writer.close()
             raise guards_mod.GuardBudgetError(
                 f"{rewind_streak} consecutive rewinds without a clean "
                 f"window (--max_bad_steps={cfg.max_bad_steps})")
         from tpu_hc_bench.utils import checkpoint as ckpt_mod
 
+        phases.enter("rewind_replay", step=i)
         if dog is not None:
             dog.pause()     # a long restore from slow storage is not a hang
         try:
@@ -1324,11 +1389,20 @@ def run_benchmark(
             next(batch_iter)
         tracker.reset()
         guard_seen_total = 0
+        # every timed step since the restored checkpoint ran for nothing
+        # — its updates were just discarded; the ledger re-attributes
+        # that span as wasted (resume-aware: restored_step counts prior
+        # runs' steps and this run's warmup)
+        lost_steps = obs_goodput.rewind_lost_steps(
+            i, restored_step, rewind_base_step, warmup_steps)
+        phases.note_lost_steps(lost_steps)
+        phases.enter("step", step=i)
         print_fn(f"rewind: non-finite step(s) in window ending step {i}; "
                  f"restored checkpoint step {restored_step}, skipping "
                  f"{skip_n} batches")
         obs_writer.event("rewind", step=i, restored_step=restored_step,
-                         skipped_batches=skip_n, streak=streak)
+                         skipped_batches=skip_n, streak=streak,
+                         lost_steps=lost_steps)
 
     try:
         if timeout_s is not None:
@@ -1355,7 +1429,12 @@ def run_benchmark(
                     and preempt_h.agreed(world)):
                 _emergency(i - 1)
             trace_window.maybe_start(i, timeline.fetcher)
+            t_dw = time.monotonic()
             batch = next(batch_iter)
+            # host time blocked on the input pipeline — carved out of
+            # the "step" phase by the ledger (a cheap float add here;
+            # the jsonl write happens once per sync window)
+            phases.note_data_wait(time.monotonic() - t_dw)
             if plan is not None:
                 plan.fire_step_faults(i, print_fn, obs_writer)
                 batch = plan.poison_batch(i, batch, print_fn, obs_writer)
@@ -1366,6 +1445,31 @@ def run_benchmark(
                 tracker.update(metrics["nonfinite"])
                 if i % timeline.sync_every == 0 or i == cfg.num_batches:
                     _poll_guard(i)
+            if i % timeline.sync_every == 0 or i == cfg.num_batches:
+                # sync-window bookkeeping: flush the accumulated
+                # data-wait into the ledger stream, beat this host's
+                # heartbeat file, and (multi-host) run the device-backed
+                # progress allgather.  The whole block is gated on
+                # cfg.metrics_dir: a bare benchmark run must not pay a
+                # memory-stats poll or a host-blocking collective inside
+                # the timed loop for telemetry nobody recorded.  The
+                # gate must be this launch-uniform IMMUTABLE flag — not
+                # fleet_writer.enabled, which a host whose heartbeat
+                # write failed flips to False unilaterally, and a
+                # collective only some hosts enter is a deadlock.  The
+                # condition is a function of i only, so the allgather
+                # executes at the same step everywhere.
+                phases.flush(i)
+                if cfg.metrics_dir:
+                    hb_step = timeline.fetcher.fetched_step
+                    ewma_ms = hb_ewma.update(hb_step)
+                    fleet_writer.heartbeat(
+                        step=hb_step, step_ewma_ms=ewma_ms,
+                        mem=obs_metrics.device_memory_stats())
+                    if world > 1:
+                        skew = obs_fleet.straggler_gather(hb_step, ewma_ms)
+                        if skew is not None:
+                            obs_writer.event("straggler", step=i, **skew)
             if (cfg.train_dir and cfg.save_model_steps
                     and i % cfg.save_model_steps == 0
                     and i < cfg.num_batches):
@@ -1416,23 +1520,33 @@ def run_benchmark(
         # zero-cost detector) instead of printing a NaN table and
         # exiting 0 the way the reference would
         obs_writer.event("nonfinite_abort", steps=nonfinite_display[:16])
+        phases.end(step=cfg.num_batches)
         obs_writer.close()
+        fleet_writer.close()
         raise guards_mod.NonFiniteError(
             f"non-finite loss at display step(s) "
             f"{nonfinite_display[:16]} (--on_nonfinite=abort; use skip "
             f"or rewind to survive, or inspect the data/lr)")
     if cfg.train_dir:
         save_now(cfg.num_batches)       # final state (tf_cnn train_dir)
+    phases.end(step=cfg.num_batches)
+    ledger = phases.ledger()
     total_rate = cfg.num_batches * global_batch / total_time
     per_chip = total_rate / layout.total_workers
     mean_ms = 1e3 * total_time / cfg.num_batches
     p50_ms = timeline.p50_step_ms()
     p50_gran = timeline.p50_granularity
 
-    # MFU: fwd+bwd ~= 3x forward FLOPs; forward-only runs use 1x
+    # MFU (obs.efficiency): the measured cost_analysis() figure when the
+    # AOT probe ran, the analytic table (fwd+bwd ~= 3x forward FLOPs;
+    # forward-only 1x) otherwise — source labeled, both recorded, loud
+    # when they disagree >10%
     flops_mult = 1.0 if cfg.forward_only else 3.0
     peak = hw.peak_flops(dtype=cfg.compute_dtype)
-    mfu = (flops_mult * spec.flops_per_example * per_chip) / peak
+    analytic_step_flops = (flops_mult * spec.flops_per_example
+                           * global_batch / layout.total_workers)
+    mfu_rep = obs_efficiency.mfu_report(
+        measured_flops, analytic_step_flops, mean_ms / 1e3, peak)
 
     result = BenchmarkResult(
         model=cfg.model,
@@ -1443,19 +1557,47 @@ def run_benchmark(
         mean_step_ms=mean_ms,
         p50_step_ms=p50_ms,
         p50_step_granularity=p50_gran,
-        mfu=mfu,
+        mfu=mfu_rep["mfu"],
         final_loss=losses[-1] if losses else float("nan"),
         fabric=fab.value,
+        goodput=ledger.goodput if ledger is not None else float("nan"),
+        mfu_source=mfu_rep["mfu_source"],
     )
-    buckets = trace_window.post_summary()
-    if buckets is not None:
-        obs_writer.event("trace_buckets", buckets=buckets)
+    tsum = trace_window.post_summary()
+    trace_rec = None
+    if tsum is not None:
+        from tpu_hc_bench.obs import trace as obs_trace
+
+        # per-collective-kind split so the ceiling attribution can name
+        # the collective, not just "collective time"
+        coll_ops: dict[str, float] = {}
+        try:
+            ops, _ = obs_trace.device_op_times(cfg.trace_dir)
+            coll_ops = obs_efficiency.collective_kind_times(ops)
+        except Exception:
+            pass
+        trace_rec = {"buckets": tsum.totals, "steps": len(tsum.steps),
+                     "collective_ops": coll_ops}
+        obs_writer.event("trace_buckets", **trace_rec)
     if hasattr(ds, "stats"):    # host decode-pool counters (real images)
         obs_writer.event("data", **ds.stats())
     mem = obs_metrics.device_memory_stats()
     obs_writer.event("memory", supported=bool(mem), devices=mem)
-    obs_writer.event("summary", **result.json_line())
+    # gradient-allreduce wire bytes (the dominant collective): what the
+    # fabric-ceiling attribution divides by.  DP/SP/TP psum+GSPMD arms
+    # only — PP's pipeline and the host fabric reduce differently.
+    summary_fields = dict(result.json_line())
+    summary_fields.update(mfu_rep)
+    if (not cfg.forward_only and pp == 1
+            and fab is not fabric_mod.Fabric.HOST
+            and hasattr(state, "params")):
+        accum_wire = (cfg.accum_dtype
+                      if cfg.gradient_accumulation_steps > 1 else "f32")
+        summary_fields["allreduce_bytes_per_step"] = \
+            obs_efficiency.grad_allreduce_bytes(state.params, accum_wire)
+    obs_writer.event("summary", **summary_fields)
     obs_writer.close()
+    fleet_writer.close()
     print_fn("-" * 40)
     print_fn(f"total {units}/sec: {total_rate:.2f}")
     # the p50 token names its own granularity: "/step" is a true per-step
@@ -1465,6 +1607,16 @@ def run_benchmark(
     p50_label = ("/step" if p50_gran == 1 else f"/{p50_gran}-step-window")
     print_fn(
         f"{units}/sec/chip: {per_chip:.2f}  step: {mean_ms:.2f}ms "
-        f"(p50{p50_label} {p50_ms:.2f}ms)  MFU: {100 * mfu:.1f}%"
+        f"(p50{p50_label} {p50_ms:.2f}ms)  MFU: {100 * result.mfu:.1f}% "
+        f"({result.mfu_source})"
     )
+    if mfu_rep.get("flops_disagree"):
+        print_fn(obs_efficiency.mfu_lines(mfu_rep)[-1].strip())
+    if ledger is not None:
+        for ln in ledger.format_lines():
+            print_fn(ln)
+    if fabric_ceiling is not None:
+        for ln in obs_efficiency.ceiling_utilization_lines(
+                summary_fields, trace_rec, fabric_ceiling):
+            print_fn(ln.strip())
     return result

@@ -454,6 +454,9 @@ def build_train_step(
     def step(state, batch, rng):
         return jitted(state, batch, rng)
 
+    # obs.efficiency AOT-lowers the same jitted callable (on abstract
+    # avals — donation-safe) for compiled.cost_analysis() measured FLOPs
+    step._jitted = jitted
     return step
 
 
@@ -510,18 +513,27 @@ def _build_gspmd_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
     if follow_inputs:
         # TP: inputs arrive committed (shard_state_tp / shard_batch); jit
         # follows those shardings and GSPMD inserts the TP collectives
-        return jax.jit(step_fn, donate_argnums=(0,))
-    from tpu_hc_bench.topology import DCN_AXIS
+        jitted = jax.jit(step_fn, donate_argnums=(0,))
+    else:
+        from tpu_hc_bench.topology import DCN_AXIS
 
-    repl = NamedSharding(mesh, P())
-    data = NamedSharding(
-        mesh, P((DCN_AXIS, DATA_AXIS)) if dcn else P(DATA_AXIS))
-    return jax.jit(
-        step_fn,
-        in_shardings=(repl, data, repl),
-        out_shardings=(repl, repl),
-        donate_argnums=(0,),
-    )
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(
+            mesh, P((DCN_AXIS, DATA_AXIS)) if dcn else P(DATA_AXIS))
+        jitted = jax.jit(
+            step_fn,
+            in_shardings=(repl, data, repl),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,),
+        )
+
+    def step(state, batch, rng):
+        return jitted(state, batch, rng)
+
+    # see build_train_step: the handle obs.efficiency AOT-lowers for
+    # compiled.cost_analysis() measured FLOPs
+    step._jitted = jitted
+    return step
 
 
 def _build_host_step(mesh: Mesh, cfg: BenchmarkConfig, is_text: bool,
