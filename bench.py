@@ -88,6 +88,12 @@ def main() -> int:
             "p50_step_ms": round(result.p50_step_ms, 3),
             "p50_step_granularity": result.p50_step_granularity,
             "dtype": cfg.compute_dtype,
+            # goodput ledger: the perf trajectory captures overlap wins
+            # (compile/checkpoint blocking shrinking), not just the
+            # images/sec headline (NaN-goodput runs carry null)
+            "goodput": (round(result.goodput, 4)
+                        if result.goodput == result.goodput else None),
+            "goodput_phases": result.goodput_phases,
         },
         "manifest": {
             k: manifest.get(k)

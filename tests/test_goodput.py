@@ -439,11 +439,14 @@ def rewind_run(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("goodput_e2e")
     ceiling = ceiling_file(tmp)
     mdir = str(tmp / "m")
+    # nan at step 1: the double-buffered guard fetch processes window
+    # 2's counters at window 4, so the rewind lands mid-run with clean
+    # replay steps after it (goodput strictly between 0 and 1)
     cfg = flags.BenchmarkConfig(
         batch_size=2, num_warmup_batches=1, num_batches=6,
         display_every=2, model="trivial", num_classes=10,
         init_learning_rate=0.05, on_nonfinite="rewind",
-        inject_fault="nan_loss@3", train_dir=str(tmp / "ck"),
+        inject_fault="nan_loss@1", train_dir=str(tmp / "ck"),
         metrics_dir=mdir, fabric_ceiling=ceiling,
     ).resolve()
     out: list[str] = []

@@ -171,10 +171,14 @@ def test_nonfinite_skip_budget_terminates(mesh8):
 
 
 def test_nonfinite_rewind_restores_and_completes(mesh8, tmp_path):
+    # nan at step 1: the double-buffered guard fetch acts one window
+    # late (snapshot at window 2, processed at window 4), so the poison
+    # must land early enough that clean replay steps remain after the
+    # restore
     mdir, ckdir = str(tmp_path / "m"), str(tmp_path / "ck")
     out = []
     res = driver.run_benchmark(
-        tiny_cfg(on_nonfinite="rewind", inject_fault="nan_loss@3",
+        tiny_cfg(on_nonfinite="rewind", inject_fault="nan_loss@1",
                  train_dir=ckdir, metrics_dir=mdir), print_fn=out.append)
     assert np.isfinite(res.final_loss)
     recs = read_metrics(mdir)
@@ -186,11 +190,19 @@ def test_nonfinite_rewind_restores_and_completes(mesh8, tmp_path):
 def test_rewind_budget_terminates_poisoned_run(mesh8, tmp_path):
     """Every window poisoned: back-to-back rewinds hit --max_bad_steps
     (same consecutive semantics as the skip budget) instead of
-    rewind-looping to the end of the run."""
-    cfg = tiny_cfg(on_nonfinite="rewind", max_bad_steps=2,
+    rewind-looping to the end of the run.
+
+    8 timed steps: under the double-buffered guard fetch a rewind wipes
+    the following window's counters (the reset), so each rewind needs
+    two windows of runway — and the wiped window must NOT pass as
+    "observed clean" and break the consecutive-rewind streak (the
+    guard_wiped_until accounting this test pins).
+    """
+    cfg = tiny_cfg(on_nonfinite="rewind", max_bad_steps=2, num_batches=8,
                    train_dir=str(tmp_path / "ck"),
                    inject_fault="nan_loss@1,nan_loss@2,nan_loss@3,"
-                                "nan_loss@4,nan_loss@5,nan_loss@6")
+                                "nan_loss@4,nan_loss@5,nan_loss@6,"
+                                "nan_loss@7,nan_loss@8")
     with pytest.raises(resilience.GuardBudgetError, match="rewinds"):
         driver.run_benchmark(cfg, print_fn=lambda s: None)
 

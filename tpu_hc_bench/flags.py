@@ -276,6 +276,30 @@ class BenchmarkConfig:
                                               # steps analog of tf_cnn's
                                               # --save_model_secs)
 
+    # --- latency hiding (round 10) ---
+    async_checkpoint: bool = True             # overlap checkpoint writes with
+                                              # the step loop: snapshot blocks
+                                              # (small), the Orbax write +
+                                              # commit runs in a background
+                                              # thread (in-flight <= 1).
+                                              # Single-process DP/TP/EP/SP
+                                              # only; emergency saves,
+                                              # io_error@ckpt injection,
+                                              # multi-host, and PP saves stay
+                                              # synchronous (driver)
+    compile_cache: str | None = None          # persistent XLA compile cache
+                                              # dir.  unset = auto: reuse an
+                                              # already-configured jax cache,
+                                              # else <train_dir>/compile_cache
+                                              # on stacks where the cache is
+                                              # safe; "off" disables; an
+                                              # explicit dir is always honored
+    prefetch_depth: int = 2                   # host->device input pipeline
+                                              # lookahead (real-data runs):
+                                              # batches kept in flight so
+                                              # decode + DMA overlap the
+                                              # running step
+
     # --- resilience (round 8; no reference analog — SURVEY.md §5 notes
     # the reference just dies) ---
     on_nonfinite: str = "abort"               # non-finite loss/grad-norm
@@ -511,6 +535,13 @@ class BenchmarkConfig:
         if self.keep_checkpoints < 0:
             raise ValueError(
                 f"--keep_checkpoints must be >= 0: {self.keep_checkpoints}")
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"--prefetch_depth must be >= 1 (1 = no lookahead): "
+                f"{self.prefetch_depth}")
+        # --compile_cache stays filesystem-pure here (same principle as
+        # --fabric_ceiling): the driver resolves auto/off and creates the
+        # directory at run start
         if self.step_timeout_s is not None:
             from tpu_hc_bench.resilience.watchdog import resolve_timeout
 
@@ -598,7 +629,8 @@ class BenchmarkConfig:
             f"data={'synthetic' if self.data_dir is None else self.data_dir}"
             + (" [repeat_cached_sample]"
                if self.datasets_repeat_cached_sample else "")
-            + f" ({self.data_name}, {self.data_format})",
+            + f" ({self.data_name}, {self.data_format})"
+            + f" prefetch_depth={self.prefetch_depth}",
             f"variable_update={self.variable_update} "
             f"fusion_threshold={self.fusion_threshold_bytes}B"
             + (f" model_parallel={self.model_parallel}"
@@ -663,6 +695,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.datasets_repeat_cached_sample)
     p.add_argument("--train_dir", type=str, default=None)
     p.add_argument("--save_model_steps", type=int, default=d.save_model_steps)
+    p.add_argument("--async_checkpoint", type=_parse_bool,
+                   default=d.async_checkpoint)
+    p.add_argument("--compile_cache", type=str, default=d.compile_cache,
+                   metavar="DIR|off")
+    p.add_argument("--prefetch_depth", type=int, default=d.prefetch_depth)
     p.add_argument("--on_nonfinite", type=str, default=d.on_nonfinite,
                    choices=["abort", "skip", "rewind"])
     p.add_argument("--max_bad_steps", type=int, default=d.max_bad_steps)

@@ -9,12 +9,22 @@ lifecycle, and folding those records (plus the resilience events of
 ``tpu_hc_bench.resilience``) yields a wall-clock account —
 
 - ``init``           backend/layout/model/data construction
-- ``compile``        the warmup loop (includes XLA compile) and the
-                     one AOT cost-analysis compile of the step
+- ``compile``        the warmup loop (includes XLA compile; with
+                     ``--compile_cache`` warm starts collapse this to
+                     trace/lower + cache loads — the AOT cost-analysis
+                     probe runs on a background thread and is not
+                     billed here)
 - ``step``           the timed training loop (the productive part)
 - ``data_wait``      host time blocked in ``next(batch_iter)`` inside
                      the timed loop (carved out of ``step``)
-- ``checkpoint``     ``--train_dir`` saves (device-syncing)
+- ``checkpoint``     synchronous ``--train_dir`` saves (device-syncing;
+                     the full snapshot + write + commit blocks)
+- ``checkpoint_async`` the BLOCKING slice of an async save: barrier on
+                     the previous write + device→host snapshot; the
+                     Orbax write/fsync/commit runs overlapped with the
+                     step loop and never enters the ledger as blocking
+                     wall (per-save ``checkpoint_commit`` records carry
+                     the overlapped write seconds)
 - ``rewind_replay``  ``--on_nonfinite=rewind`` restores
 - ``emergency_save`` the preemption path's final checkpoint
 - ``idle``           anything explicitly marked idle (none in a
@@ -48,7 +58,7 @@ import dataclasses
 import time
 
 PHASES = ("init", "compile", "step", "data_wait", "checkpoint",
-          "rewind_replay", "emergency_save", "idle")
+          "checkpoint_async", "rewind_replay", "emergency_save", "idle")
 END_PHASE = "end"
 
 

@@ -162,12 +162,40 @@ class MetricsWriter:
         os.makedirs(out_dir, exist_ok=True)
         self.out_dir = out_dir
         if manifest is not None:
-            with open(os.path.join(out_dir, MANIFEST_NAME), "w") as f:
-                json.dump(manifest, f, indent=2, default=str)
-                f.write("\n")
-                f.flush()
-                os.fsync(f.fileno())
+            self._write_manifest(manifest)
         self._f = open(os.path.join(out_dir, METRICS_NAME), "w")
+
+    def _write_manifest(self, manifest: dict) -> None:
+        # tmp -> fsync -> rename: update_manifest rewrites an already-
+        # good manifest, and a crash mid-rewrite must not destroy the
+        # identity record the eager construction-time write guaranteed
+        path = os.path.join(self.out_dir, MANIFEST_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2, default=str)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def update_manifest(self, fields: dict) -> None:
+        """Merge ``fields`` into the on-disk manifest.
+
+        The manifest is written eagerly at construction (so even a
+        crashed run identifies itself), but some identity facts only
+        exist later — compile-cache hit/miss is known after warmup.
+        Best-effort: a manifest amendment must never kill a run.
+        """
+        if self._f is None:
+            return
+        try:
+            path = os.path.join(self.out_dir, MANIFEST_NAME)
+            with open(path) as f:
+                manifest = json.load(f)
+            manifest.update(fields)
+            self._write_manifest(manifest)
+        except (OSError, json.JSONDecodeError) as e:
+            sys.stderr.write(f"WARNING: manifest update failed: {e}\n")
 
     @property
     def enabled(self) -> bool:
@@ -365,6 +393,12 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
     ledger = goodput_mod.build_ledger(records)
     if ledger is not None:
         lines.extend("  " + ln for ln in ledger.format_lines())
+    commits = _of_kind(records, "checkpoint_commit")
+    if commits:
+        total_w = sum(float(c.get("write_s", 0) or 0) for c in commits)
+        lines.append(f"  async checkpoints: {len(commits)} landed, "
+                     f"{total_w:.2f}s of writes overlapped with the "
+                     f"step loop")
     try:
         run_dir = os.path.dirname(resolve_run(path)[1])
         lines.extend(fleet_mod.straggler_lines(run_dir, records))
@@ -473,6 +507,25 @@ def diff_runs(path_a: str, path_b: str,
         # before anyone reads the delta row as a regression
         lines.append(f"  note: MFU flops source differs: "
                      f"{src_a or '?'} -> {src_b or '?'}")
+
+    # ledger phase deltas: where the non-productive wall moved — a warm
+    # compile cache shows up as the compile row collapsing, async
+    # checkpointing as checkpoint(blocking) -> checkpoint_async(small)
+    from tpu_hc_bench.obs import goodput as goodput_mod
+
+    led_a = goodput_mod.build_ledger(recs_a)
+    led_b = goodput_mod.build_ledger(recs_b)
+    if led_a is not None and led_b is not None:
+        rows = [p for p in goodput_mod.PHASES
+                if (led_a.seconds.get(p, 0.0) > 0.0
+                    or led_b.seconds.get(p, 0.0) > 0.0)]
+        if rows:
+            lines.append("  ledger phases (wall s):")
+            for p in rows:
+                va = led_a.seconds.get(p, 0.0)
+                vb = led_b.seconds.get(p, 0.0)
+                lines.append(f"  {p:>14s} {va:12.2f} {vb:12.2f} "
+                             f"{_pct(va, vb):>8s}")
 
     tb_a = _last(recs_a, "trace_buckets")
     tb_b = _last(recs_b, "trace_buckets")

@@ -13,9 +13,13 @@ freed buffers.  The state is threaded through the select instead.
 
 Budget accounting (``GuardTracker``) stays on device as two int32
 scalars updated by a tiny jitted program per step — no host sync in the
-dispatch path.  The driver polls them (one scalar fetch) once per sync
-window, which is where the ``--max_bad_steps`` consecutive-failure
-budget is enforced so a poisoned run still terminates.
+dispatch path.  The driver observes them once per sync window through a
+DOUBLE-BUFFERED fetch (``handles`` snapshots the refs at window N, the
+values are fetched at window N+1 when they are long complete — the hot
+loop never stalls on the fetch), enforcing the ``--max_bad_steps``
+consecutive-failure budget one window late; saves, preemption, and the
+final step settle synchronously (``poll``) so a poisoned run still
+terminates and poisoned state is never persisted.
 """
 
 from __future__ import annotations
@@ -88,6 +92,16 @@ class GuardTracker:
         streak, total, peak = jax.device_get(
             [self._streak, self._total, self._peak])
         return int(streak), int(total), int(peak)
+
+    def handles(self) -> tuple:
+        """The live ``(streak, total, peak)`` device scalars, as refs.
+
+        The driver's double-buffered window poll snapshots these at a
+        sync-window boundary and ``device_get``s them one window LATER,
+        when their producing steps have long completed — a fetch that
+        never stalls the dispatch path (``_advance`` returns fresh
+        arrays each step, so held refs are a stable snapshot)."""
+        return (self._streak, self._total, self._peak)
 
     def reset(self) -> None:
         self._streak = jnp.zeros((), jnp.int32)

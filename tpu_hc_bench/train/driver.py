@@ -64,6 +64,10 @@ class BenchmarkResult:
     # wall-clock goodput fraction (obs.goodput ledger): productive step
     # seconds / wall seconds; NaN where no ledger ran (eval, PP arms)
     goodput: float = float("nan")
+    # the ledger's phase breakdown (phase -> wall seconds, zero phases
+    # omitted): how the non-productive wall was spent — compile,
+    # checkpoint blocking, data waits.  None where no ledger ran.
+    goodput_phases: dict | None = None
     # where the MFU's FLOP figure came from: "measured" =
     # compiled.cost_analysis() of the actual step program, "analytic" =
     # the hand-maintained spec.flops_per_example table (obs.efficiency)
@@ -89,7 +93,7 @@ def _example_units(cfg: BenchmarkConfig, spec) -> str:
 
 
 def _prefetch(gen, lookahead: int = 2):
-    """Keep `lookahead` device batches in flight.
+    """Keep `lookahead` device batches in flight (``--prefetch_depth``).
 
     jax.device_put is asynchronous, so pulling the generator ahead of the
     consumer overlaps host decode + host->device DMA with the running step
@@ -104,6 +108,69 @@ def _prefetch(gen, lookahead: int = 2):
             yield q.popleft()
     while q:
         yield q.popleft()
+
+
+def _cache_entry_count(cache_dir: str) -> int:
+    """Files under the compile-cache dir — the hit/miss denominator:
+    entries that appear between run start and end-of-warmup are the
+    compile-cache misses this run paid for."""
+    import os
+
+    count = 0
+    for _, _, files in os.walk(cache_dir):
+        count += len(files)
+    return count
+
+
+def _resolve_compile_cache(cfg: BenchmarkConfig, print_fn) -> str | None:
+    """Resolve ``--compile_cache`` into an ACTIVE persistent-compile-
+    cache dir (or None), before anything lowers.
+
+    Policy: ``off`` disables; an explicit dir is always honored (loud
+    warning on jax<0.5, where executing cache-deserialized CPU
+    executables has corrupted the heap on some programs — the
+    tests/conftest.py note); unset = auto: a cache dir already
+    configured on ``jax.config`` is reused untouched (the test
+    harness's shared cache, an operator's env), else ``--train_dir``
+    implies ``<train_dir>/compile_cache`` on capable stacks.
+    """
+    import os
+
+    from tpu_hc_bench._compat import CAPABILITIES
+
+    spec = cfg.compile_cache
+    if spec is not None and spec.strip().lower() in ("off", "none", "0",
+                                                     ""):
+        return None
+    existing = None
+    try:
+        existing = jax.config.jax_compilation_cache_dir
+    except Exception:
+        pass
+    if spec:
+        cache_dir = spec
+    elif existing:
+        return existing
+    elif cfg.train_dir and CAPABILITIES["persistent_compilation_cache"]:
+        cache_dir = os.path.join(cfg.train_dir, "compile_cache")
+    else:
+        return None
+    if not CAPABILITIES["persistent_compilation_cache"]:
+        print_fn(
+            "WARNING: --compile_cache on a jax<0.5 stack: executing "
+            "cache-deserialized CPU executables has corrupted the heap "
+            "on some programs (tests/conftest.py note); honoring the "
+            "explicit flag anyway")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        # cache sub-second compiles too: warm-start wins on small
+        # programs are the point, and entries are cheap
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    except Exception:
+        pass
+    return cache_dir
 
 
 class _ArrivalFetcher:
@@ -598,6 +665,13 @@ def run_benchmark(
     # before warmup, not after the full run when the summary needs it
     fabric_ceiling = (obs_efficiency.load_fabric_ceiling(cfg.fabric_ceiling)
                       if cfg.fabric_ceiling else None)
+    # persistent compile cache (--compile_cache): activated before
+    # anything lowers, so the warmup's compiles hit (warm start) or
+    # populate (cold start) it; hit/miss is measured over the warmup
+    # and recorded in the manifest
+    compile_cache_dir = _resolve_compile_cache(cfg, print_fn)
+    cache_entries_before = (_cache_entry_count(compile_cache_dir)
+                            if compile_cache_dir else 0)
     layout = layout or discover_layout()
     # TP/EP claim the mesh's "model" axis, PP "pipe", SP "seq".  Round 2:
     # minor axes COMPOSE — DPxPPxTP and DPxSPxTP are the supported 3-D
@@ -795,6 +869,9 @@ def run_benchmark(
             f"multislice: {num_slices} slices x {per_slice} — data axis = "
             f"dcn({num_slices}) x data({layout.total_workers // num_slices})")
     print_fn(f"device_kind={hw.device_kind()} global_batch={global_batch}")
+    if compile_cache_dir:
+        print_fn(f"compile cache: {compile_cache_dir} "
+                 f"({cache_entries_before} entries at start)")
     for line in hw.ici_topology_lines():
         print_fn(line)
 
@@ -807,8 +884,12 @@ def run_benchmark(
     if cfg.metrics_dir and jax.process_index() == 0:
         obs_writer = obs_metrics.MetricsWriter(
             cfg.metrics_dir,
-            obs_metrics.run_manifest(cfg=cfg, layout=layout, mesh=mesh,
-                                     fabric=fab.value),
+            obs_metrics.run_manifest(
+                cfg=cfg, layout=layout, mesh=mesh, fabric=fab.value,
+                extra=({"compile_cache": {
+                            "dir": compile_cache_dir,
+                            "entries_before": cache_entries_before}}
+                       if compile_cache_dir else None)),
             primary=True)
         print_fn(f"metrics: {cfg.metrics_dir}/{obs_metrics.METRICS_NAME} "
                  f"(+ {obs_metrics.MANIFEST_NAME}); live view: "
@@ -880,7 +961,7 @@ def run_benchmark(
 
                     for b in itertools.chain([batch], host_iter):
                         yield step_mod.shard_batch(b, mesh)
-                yield from _prefetch(raw())
+                yield from _prefetch(raw(), cfg.prefetch_depth)
     elif spec.is_text and cfg.data_dir is not None:
         # real pre-tokenized corpus (<data_dir>/<split>.bin memmap) — the
         # reference's real-data axis for the text members (round 3)
@@ -911,7 +992,7 @@ def run_benchmark(
 
                 for b in itertools.chain([batch], host_iter):
                     yield step_mod.shard_batch(b, mesh, batch_spec)
-            yield from _prefetch(raw())
+            yield from _prefetch(raw(), cfg.prefetch_depth)
     elif spec.is_text:
         seq_len = spec.input_shape[0]
         ds = SyntheticTokens(global_batch, seq_len, seed=cfg.seed,
@@ -1185,15 +1266,35 @@ def run_benchmark(
         f"warmup done: {cfg.num_warmup_batches} steps in "
         f"{warmup_elapsed:.1f}s (includes compile)"
     )
+    if compile_cache_dir:
+        # hit/miss accounting: entries that appeared during warmup are
+        # the compiles this run actually paid for; zero new entries over
+        # a non-empty cache is a warm start (the ledger's compile phase
+        # shows the wall-clock consequence)
+        cache_entries_after = _cache_entry_count(compile_cache_dir)
+        cache_new = cache_entries_after - cache_entries_before
+        cache_warm = cache_new == 0 and cache_entries_before > 0
+        print_fn(f"compile cache: {cache_new} new entr"
+                 f"{'y' if cache_new == 1 else 'ies'} "
+                 f"({'warm start' if cache_warm else 'cold/partial'}); "
+                 f"{cache_entries_after} total")
+        cache_rec = {"dir": compile_cache_dir,
+                     "entries_before": cache_entries_before,
+                     "entries_after": cache_entries_after,
+                     "new_entries": cache_new, "warm": cache_warm}
+        obs_writer.event("compile_cache", **cache_rec)
+        obs_writer.update_manifest({"compile_cache": cache_rec})
 
     # measured FLOPs (obs.efficiency): AOT-lower the very step program
     # and ask XLA's cost analysis — the honest MFU numerator.  Only on
     # observability-enabled runs: the extra compile is wasted wall on a
-    # bare benchmark run (and still lands inside the ledger's "compile"
-    # phase here, before the timed loop starts).
-    measured_flops = None
+    # bare benchmark run.  Round 10: the probe runs on a BACKGROUND
+    # thread (pure telemetry — nothing the loop depends on), so its
+    # lower+compile overlaps the timed loop instead of sitting in the
+    # ledger's compile phase; the result is joined after the loop.
+    flops_probe = None
     if obs_writer.enabled or cfg.fabric_ceiling:
-        measured_flops = obs_efficiency.measured_step_flops(
+        flops_probe = obs_efficiency.StepFlopsProbe(
             train_step, state, warm_batch, rng)
     # drop the reference NOW: the probe only needed shapes, and holding
     # the last warmup batch through the timed run would pin one extra
@@ -1242,7 +1343,73 @@ def run_benchmark(
         cfg.step_timeout_s, warmup_elapsed / warmup_steps)
     dog = None
 
+    # async checkpoint writer (round 10): periodic saves overlap their
+    # Orbax write with the step loop; only the device→host snapshot
+    # blocks.  Synchronous whenever the save is collective or must
+    # preserve the resilience exit-code contract: multi-host (Orbax
+    # barriers + the sentinel wait are collective — a backgrounded
+    # collective on some hosts is a deadlock), PP (restack/stacked
+    # layouts), sharded states, io_error@ckpt injection (the retry
+    # proof drives the sync path), and every emergency/preempt save.
+    async_ckpt = None
+    if (cfg.train_dir and cfg.async_checkpoint and world == 1
+            and pp == 1 and not sharded_ckpt
+            and not (plan is not None and plan.io_error)):
+        from tpu_hc_bench.utils import checkpoint as ckpt_mod
+
+        async_ckpt = ckpt_mod.AsyncCheckpointWriter(cfg.train_dir,
+                                                    print_fn=print_fn)
+        print_fn("checkpointing: async (snapshot blocks, write "
+                 "overlapped, in-flight <= 1; emergency saves stay "
+                 "synchronous)")
+
+    def _drain_async_commits() -> None:
+        """Move landed-save records from the writer thread's queue into
+        the metrics stream — on the main thread, where MetricsWriter
+        is safe to touch."""
+        if async_ckpt is None:
+            return
+        while async_ckpt.commits:
+            obs_writer.event("checkpoint_commit",
+                             **async_ckpt.commits.popleft())
+
+    def _flush_async_for_exit() -> None:
+        """Land (or report) any in-flight overlapped save before a
+        fatal-exit path closes the writers — a background write error
+        or an unrecorded commit must not vanish under the budget/abort
+        error that outranks it."""
+        if async_ckpt is None:
+            return
+        try:
+            async_ckpt.wait()
+        except Exception as e:
+            print_fn(f"WARNING: async checkpoint write failed during "
+                     f"abort: {e}")
+            obs_writer.event("async_ckpt_error", error=str(e))
+        _drain_async_commits()
+
     def save_now(i: int, phase: str = "checkpoint") -> None:
+        if async_ckpt is not None and phase == "checkpoint":
+            # overlapped save: barrier on the previous write (usually
+            # long landed — a save per sync window leaves a whole
+            # window to finish), snapshot to host, hand off.  The
+            # ledger's checkpoint_async phase records only this
+            # blocking slice; the write's own seconds ride the
+            # checkpoint_commit record it queues when it lands.
+            if dog is not None:
+                dog.pause()
+            phases.enter("checkpoint_async", step=i)
+            t_snap = time.monotonic()
+            try:
+                async_ckpt.submit(state, gc_keep=cfg.keep_checkpoints)
+                print_fn(f"checkpoint snapshot: step {i} "
+                         f"({time.monotonic() - t_snap:.3f}s blocking; "
+                         f"write overlapped)")
+            finally:
+                phases.enter("step", step=i)
+                if dog is not None:
+                    dog.resume()
+            return
         def _do() -> None:
             if plan is not None:
                 plan.maybe_io_error("ckpt")
@@ -1294,13 +1461,18 @@ def run_benchmark(
         print_fn(f"preemption: stopping after timed step {completed} "
                  f"(signal {preempt_h.signum})")
         phases.enter("emergency_save", step=completed)
+        if async_ckpt is not None:
+            # land (or surface the failure of) any in-flight overlapped
+            # save before the emergency save claims the same directory
+            async_ckpt.wait()
+            _drain_async_commits()
         saved = bool(cfg.train_dir)
         if saved and tracker is not None:
             # settle the guard first: under rewind the state may carry
             # poisoned mid-window updates, and the emergency checkpoint
             # must never persist them for --resume=auto to restore
             try:
-                _poll_guard(completed)
+                _settle_guard(completed)
             except guards_mod.GuardBudgetError:
                 saved = False   # budget died on poisoned state: keep it
                                 # off disk, exit preempted without a save
@@ -1321,29 +1493,47 @@ def run_benchmark(
     guard_seen_total = 0
     guard_last_poll_i = 0
     rewind_streak = 0
+    # Non-blocking sync windows (round 10): the guard-counter fetch is
+    # DOUBLE-BUFFERED.  At each sync window the driver snapshots the
+    # device counters (refs only — no fetch) and fetches the PREVIOUS
+    # window's snapshot: a full window of compute has drained behind
+    # those scalars, so the device_get returns without stalling the
+    # dispatch path, and the hot loop never synchronously round-trips
+    # mid-run.  Policy therefore acts one window late; the settle paths
+    # (_settle_guard: before saves, at preemption, at the final step)
+    # flush the pipeline AND poll live, so no badness is ever persisted
+    # to disk or survives the run unseen.
+    guard_pending: list = []    # [(window_end_step, counter handles)]
+    guard_wiped_until = -1      # a rewind's tracker.reset() wipes the
+                                # counters for steps up to this stamp:
+                                # that window must not pass as
+                                # "observed clean" and break the
+                                # consecutive-rewind budget
 
-    def _poll_guard(i: int) -> None:
-        """Sync-window guard poll: enforce --max_bad_steps, emit events,
-        run the rewind restore.  The one deliberate host sync of the
-        resilience path (skip/rewind policies only)."""
-        nonlocal guard_seen_total, guard_last_poll_i, rewind_streak, state
-        steps_since = i - guard_last_poll_i
-        guard_last_poll_i = i
-        streak, total, peak = tracker.poll()
+    def _apply_guard(j: int, streak: int, total: int, peak: int,
+                     now_i: int) -> None:
+        """Enforce --max_bad_steps / run the rewind restore on counters
+        observed through step ``j`` (``now_i`` = the loop's current
+        step — under the deferred fetch, later than ``j``)."""
+        nonlocal guard_seen_total, guard_last_poll_i, rewind_streak
+        nonlocal guard_wiped_until, state
+        steps_since = j - guard_last_poll_i
+        guard_last_poll_i = j
         new_bad = total - guard_seen_total
         if new_bad <= 0:
             # only a CLEAN window with actual steps in it breaks a rewind
-            # streak — a second poll at the same step (the settle-before-
-            # save path) must not erase the budget accounting
-            if steps_since > 0:
+            # streak — not a second poll at the same step (the settle-
+            # before-save path), and not a window whose counters a
+            # rewind's reset wiped (the post-restore replay span)
+            if steps_since > 0 and j > guard_wiped_until:
                 rewind_streak = 0
             return
         guard_seen_total = total
         if policy == "skip":
             print_fn(f"nonfinite: dropped {new_bad} update(s) in window "
-                     f"ending step {i} (consecutive {streak}, "
+                     f"ending step {j} (consecutive {streak}, "
                      f"total {total})")
-            obs_writer.event("nonfinite_skip", step=i, new_bad=new_bad,
+            obs_writer.event("nonfinite_skip", step=j, new_bad=new_bad,
                              streak=streak, total=total)
             # dropped updates burned step time whose work was discarded:
             # the goodput ledger counts them against the run
@@ -1352,7 +1542,8 @@ def run_benchmark(
             # inside the window (streak already reset by a good step)
             # still counts
             if peak >= cfg.max_bad_steps:
-                phases.end(step=i)
+                _flush_async_for_exit()
+                phases.end(step=j)
                 obs_writer.close()
                 fleet_writer.close()
                 raise guards_mod.GuardBudgetError(
@@ -1365,7 +1556,8 @@ def run_benchmark(
         # max_bad_steps-th consecutive bad window.
         rewind_streak += 1
         if rewind_streak >= cfg.max_bad_steps:
-            phases.end(step=i)
+            _flush_async_for_exit()
+            phases.end(step=j)
             obs_writer.close()
             fleet_writer.close()
             raise guards_mod.GuardBudgetError(
@@ -1373,10 +1565,15 @@ def run_benchmark(
                 f"window (--max_bad_steps={cfg.max_bad_steps})")
         from tpu_hc_bench.utils import checkpoint as ckpt_mod
 
-        phases.enter("rewind_replay", step=i)
+        phases.enter("rewind_replay", step=now_i)
         if dog is not None:
             dog.pause()     # a long restore from slow storage is not a hang
         try:
+            if async_ckpt is not None:
+                # the newest overlapped save must land (or its failure
+                # surface) before we pick the checkpoint to restore
+                async_ckpt.wait()
+                _drain_async_commits()
             restored = ckpt_mod.restore(state, cfg.train_dir,
                                         sharded=sharded_ckpt)
             state = restored if sharded_ckpt else place_fn(restored)
@@ -1388,21 +1585,39 @@ def run_benchmark(
         for _ in range(skip_n):
             next(batch_iter)
         tracker.reset()
+        guard_pending.clear()   # snapshot refs predate the reset: a
+                                # deferred fetch would re-report the
+                                # badness this restore just cured
+        guard_wiped_until = now_i
         guard_seen_total = 0
         # every timed step since the restored checkpoint ran for nothing
         # — its updates were just discarded; the ledger re-attributes
         # that span as wasted (resume-aware: restored_step counts prior
         # runs' steps and this run's warmup)
         lost_steps = obs_goodput.rewind_lost_steps(
-            i, restored_step, rewind_base_step, warmup_steps)
+            now_i, restored_step, rewind_base_step, warmup_steps)
         phases.note_lost_steps(lost_steps)
-        phases.enter("step", step=i)
-        print_fn(f"rewind: non-finite step(s) in window ending step {i}; "
+        phases.enter("step", step=now_i)
+        print_fn(f"rewind: non-finite step(s) in window ending step {j}; "
                  f"restored checkpoint step {restored_step}, skipping "
                  f"{skip_n} batches")
-        obs_writer.event("rewind", step=i, restored_step=restored_step,
+        obs_writer.event("rewind", step=now_i, restored_step=restored_step,
                          skipped_batches=skip_n, streak=streak,
                          lost_steps=lost_steps)
+
+    def _fetch_guard(handles) -> tuple[int, int, int]:
+        streak, total, peak = jax.device_get(list(handles))
+        return int(streak), int(total), int(peak)
+
+    def _settle_guard(i: int) -> None:
+        """Flush the deferred guard pipeline, then poll the live
+        counters — the one deliberate host sync of the resilience path,
+        paid only where state is about to be persisted (saves,
+        preemption) or the run is ending."""
+        while guard_pending:
+            j, handles = guard_pending.pop(0)
+            _apply_guard(j, *_fetch_guard(handles), now_i=i)
+        _apply_guard(i, *tracker.poll(), now_i=i)
 
     try:
         if timeout_s is not None:
@@ -1443,8 +1658,18 @@ def run_benchmark(
             timeline.record(i, metrics["loss"])
             if tracker is not None:
                 tracker.update(metrics["nonfinite"])
-                if i % timeline.sync_every == 0 or i == cfg.num_batches:
-                    _poll_guard(i)
+                if i == cfg.num_batches:
+                    # run end: flush the deferred window AND the live
+                    # counters — nothing may survive the run unseen
+                    _settle_guard(i)
+                elif i % timeline.sync_every == 0:
+                    # double-buffered: fetch window N-1's counters
+                    # (complete long ago — no stall) while window N's
+                    # steps execute; snapshot this window's refs
+                    if guard_pending:
+                        j, handles = guard_pending.pop(0)
+                        _apply_guard(j, *_fetch_guard(handles), now_i=i)
+                    guard_pending.append((i, tracker.handles()))
             if i % timeline.sync_every == 0 or i == cfg.num_batches:
                 # sync-window bookkeeping: flush the accumulated
                 # data-wait into the ledger stream, beat this host's
@@ -1460,6 +1685,7 @@ def run_benchmark(
                 # condition is a function of i only, so the allgather
                 # executes at the same step everywhere.
                 phases.flush(i)
+                _drain_async_commits()
                 if cfg.metrics_dir:
                     hb_step = timeline.fetcher.fetched_step
                     ewma_ms = hb_ewma.update(hb_step)
@@ -1480,9 +1706,9 @@ def run_benchmark(
                     # settle the guard first: under rewind the state may
                     # carry un-detected poisoned updates mid-window, and
                     # persisting them would make the poisoned checkpoint
-                    # the one rewind restores (the save syncs anyway, so
-                    # the extra poll is free)
-                    _poll_guard(i)
+                    # the one rewind restores (the save syncs on the
+                    # state anyway, so the flush is free)
+                    _settle_guard(i)
                 save_now(i)
             trace_window.poll(timeline.fetcher.fetched_step)
     except BaseException:
@@ -1520,6 +1746,7 @@ def run_benchmark(
         # zero-cost detector) instead of printing a NaN table and
         # exiting 0 the way the reference would
         obs_writer.event("nonfinite_abort", steps=nonfinite_display[:16])
+        _flush_async_for_exit()
         phases.end(step=cfg.num_batches)
         obs_writer.close()
         fleet_writer.close()
@@ -1529,6 +1756,13 @@ def run_benchmark(
             f"or rewind to survive, or inspect the data/lr)")
     if cfg.train_dir:
         save_now(cfg.num_batches)       # final state (tf_cnn train_dir)
+    if async_ckpt is not None:
+        # exit barrier: the final overlapped write must land (and any
+        # background write error must surface) before the run reports
+        # success; the wait is accounted as checkpoint_async blocking
+        phases.enter("checkpoint_async", step=cfg.num_batches)
+        async_ckpt.wait()
+        _drain_async_commits()
     phases.end(step=cfg.num_batches)
     ledger = phases.ledger()
     total_rate = cfg.num_batches * global_batch / total_time
@@ -1540,7 +1774,10 @@ def run_benchmark(
     # MFU (obs.efficiency): the measured cost_analysis() figure when the
     # AOT probe ran, the analytic table (fwd+bwd ~= 3x forward FLOPs;
     # forward-only 1x) otherwise — source labeled, both recorded, loud
-    # when they disagree >10%
+    # when they disagree >10%.  The background probe has had the whole
+    # timed loop to finish; the join here is normally instant.
+    measured_flops = (flops_probe.result() if flops_probe is not None
+                      else None)
     flops_mult = 1.0 if cfg.forward_only else 3.0
     peak = hw.peak_flops(dtype=cfg.compute_dtype)
     analytic_step_flops = (flops_mult * spec.flops_per_example
@@ -1561,6 +1798,9 @@ def run_benchmark(
         final_loss=losses[-1] if losses else float("nan"),
         fabric=fab.value,
         goodput=ledger.goodput if ledger is not None else float("nan"),
+        goodput_phases=({k: round(v, 3)
+                         for k, v in ledger.seconds.items() if v > 0.0}
+                        if ledger is not None else None),
         mfu_source=mfu_rep["mfu_source"],
     )
     tsum = trace_window.post_summary()

@@ -18,14 +18,30 @@ then chokes on; ``restore(step=None)`` therefore falls back to the
 newest *complete* step automatically.  ``gc_checkpoints`` is the
 ``--keep_checkpoints=N`` retention pass (newest N complete steps
 survive; stale ``.tmp``/sentinel-less debris is reaped).
+
+Async saves (round 10): a synchronous ``save`` blocks the step loop
+for snapshot + Orbax write + fsync + commit, but only the *snapshot*
+actually needs the step loop stopped — the write targets host memory
+the device no longer owns.  ``AsyncCheckpointWriter`` splits the save
+there: ``submit`` snapshots device arrays to host (per-leaf
+``copy_to_host_async`` so the transfers overlap, then one gather) and
+hands the payload to a bounded background thread that runs the SAME
+tmp→rename→sentinel commit protocol.  At most one save is in flight;
+``wait()`` is the barrier (before the next save, GC, restore, and
+exit) and the place a background write error re-raises on the main
+thread.  A crash mid-write leaves an uncommitted ``.tmp``/sentinel-
+less dir that discovery already ignores — the async path adds no new
+failure modes to the commit protocol.
 """
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import os
 import re
 import shutil
+import threading
 import time
 from pathlib import Path
 
@@ -109,6 +125,50 @@ def _commit_step_dir(base: Path, step: int, tmp: Path,
     return final
 
 
+def snapshot_to_host(state: TrainState) -> tuple[int, dict]:
+    """Snapshot the array state to host memory: ``(step, payload)``.
+
+    This is the only part of a save that must block the step loop.
+    Every leaf's device→host copy is *started* first
+    (``copy_to_host_async``) so the transfers run concurrently; the
+    ``device_get`` gather then mostly finds bytes already landed.
+    Requires a fully-addressable state (replicated or single-process) —
+    the same contract as host-mode ``save``.
+    """
+    step = int(jax.device_get(state.step))
+    trees = {
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }
+    for leaf in jax.tree.leaves(trees):
+        if isinstance(leaf, jax.Array):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:
+                pass    # backend without async copies: the gather pays
+    payload: dict = {"step": np.asarray(step)}
+    for name, tree in trees.items():
+        payload[name] = jax.device_get(tree)
+    return step, payload
+
+
+def write_host_payload(payload: dict, directory: str | Path,
+                       step: int) -> Path:
+    """Orbax-write a payload under the commit protocol (tmp dir →
+    rename → sentinel).  The payload is host arrays (the async writer's
+    snapshot — pure host/filesystem work, safe off the main thread) or
+    live ``jax.Array``s (the sharded path: Orbax writes each process's
+    addressable shards and synchronizes internally)."""
+    base = Path(directory)
+    base.mkdir(parents=True, exist_ok=True)
+    tmp = base / (_step_dir(base, step).name + ".tmp")
+    stale_id = _marker_id(_marker(base, step))
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(tmp.resolve(), payload, force=True)
+    return _commit_step_dir(base, step, tmp, stale_id)
+
+
 def save(state: TrainState, directory: str | Path,
          sharded: bool = False) -> Path:
     """Save the array state of `state` at its current step.
@@ -119,21 +179,107 @@ def save(state: TrainState, directory: str | Path,
     call.  Default (host) mode device_gets first, which requires the
     state to be fully addressable (replicated or single-process).
     """
-    base = Path(directory)
-    base.mkdir(parents=True, exist_ok=True)
-    step = int(jax.device_get(state.step))
-    tmp = base / (_step_dir(base, step).name + ".tmp")
-    stale_id = _marker_id(_marker(base, step))
-    pull = (lambda t: t) if sharded else jax.device_get
-    payload = {
-        "step": np.asarray(step),
-        "params": pull(state.params),
-        "batch_stats": pull(state.batch_stats),
-        "opt_state": pull(state.opt_state),
-    }
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(tmp.resolve(), payload, force=True)
-    return _commit_step_dir(base, step, tmp, stale_id)
+    if sharded:
+        step = int(jax.device_get(state.step))
+        payload = {
+            "step": np.asarray(step),
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+        }
+    else:
+        step, payload = snapshot_to_host(state)
+    return write_host_payload(payload, directory, step)
+
+
+class AsyncCheckpointWriter:
+    """Bounded background checkpoint writer: in-flight ≤ 1.
+
+    ``submit`` barriers on the previous save, snapshots the state to
+    host (the only blocking span), and hands the payload to a daemon
+    thread that runs ``write_host_payload`` (Orbax write + fsync +
+    rename + sentinel) and, when asked, the retention GC — all off the
+    step loop.  ``wait()`` is the barrier the driver runs before GC,
+    restore, emergency saves, and exit; a background write error is
+    captured and re-raised there, on the main thread, with the writer-
+    thread traceback attached.
+
+    Single-process only by design: multi-host saves are COLLECTIVE
+    (Orbax barriers every writer, then non-zero processes wait on the
+    commit sentinel), and a collective running on a background thread
+    on some hosts while others have already moved on is a deadlock —
+    the driver keeps multi-host, PP-native, and sharded saves on the
+    synchronous path.
+
+    ``commits`` is a thread-safe queue of landed-save records
+    (``{"step", "write_s", "path"}``) the driver drains into the
+    metrics stream from the main thread (MetricsWriter is not
+    thread-safe, so the writer thread never touches it).
+    """
+
+    def __init__(self, directory: str | Path, print_fn=None):
+        self._dir = Path(directory)
+        self._print = print_fn or (lambda s: None)
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._error_tb = None
+        self.commits: collections.deque = collections.deque()
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def submit(self, state: TrainState, gc_keep: int = 0) -> int:
+        """Barrier on the previous save, snapshot, hand off.  Returns
+        the snapshotted step.  Blocking cost: the previous write's
+        remaining tail (usually zero — one save per sync window leaves
+        a whole window to finish) plus the device→host snapshot."""
+        self.wait()
+        step, payload = snapshot_to_host(state)
+        self._thread = threading.Thread(
+            target=self._write, args=(step, payload, gc_keep),
+            name=f"tpu-hc-bench-ckpt-writer-{step}", daemon=True)
+        self._thread.start()
+        return step
+
+    def _write(self, step: int, payload: dict, gc_keep: int) -> None:
+        from tpu_hc_bench.resilience.retry import retry_io
+
+        t0 = time.monotonic()
+        try:
+            # same transient-I/O budget as the synchronous save path
+            # (driver.save_now's retry_io): an NFS/GCS blip must not
+            # surface at the next barrier as a run-killing error.
+            # Single-process by construction, so retrying is safe
+            # (multi-host saves never take the async path).
+            path = retry_io(
+                lambda: write_host_payload(payload, self._dir, step),
+                what="async checkpoint write", print_fn=self._print)
+            if gc_keep:
+                gc_checkpoints(self._dir, gc_keep, print_fn=self._print)
+            dt = time.monotonic() - t0
+            self.commits.append(
+                {"step": step, "write_s": round(dt, 4), "path": str(path)})
+            self._print(f"checkpoint saved: {path} "
+                        f"(async write {dt:.2f}s, overlapped)")
+        except BaseException as e:
+            self._error = e
+            self._error_tb = e.__traceback__
+
+    def wait(self) -> None:
+        """Barrier: join any in-flight write; re-raise its error here."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            exc, self._error = self._error, None
+            if hasattr(exc, "add_note"):
+                exc.add_note(
+                    "raised in the async checkpoint writer thread; "
+                    "re-raised at the next barrier "
+                    "(utils.checkpoint.AsyncCheckpointWriter.wait)")
+            raise exc.with_traceback(self._error_tb)
 
 
 def complete_steps(directory: str | Path) -> list[int]:
