@@ -55,6 +55,13 @@ def main() -> int:
         # enable; only the v1 bottleneck resnets accept it, so default off
         # keeps every BENCH_MODEL working)
         fused_conv=os.environ.get("BENCH_FUSED_CONV", "0") == "1",
+        # round 6: gradient-arm A/B knobs — psum (default) | replicated |
+        # zero1, the Horovod 128 MiB fusion threshold, and the
+        # overlapped-vs-serialized collective schedule
+        variable_update=os.environ.get("BENCH_VARIABLE_UPDATE", "psum"),
+        fusion_threshold_bytes=int(os.environ.get(
+            "BENCH_FUSION_THRESHOLD", "134217728")),
+        overlap_grad_comm=os.environ.get("BENCH_OVERLAP", "on"),
     ).resolve()
 
     # human-readable progress to stderr; stdout carries only the JSON line
@@ -88,6 +95,12 @@ def main() -> int:
             "p50_step_ms": round(result.p50_step_ms, 3),
             "p50_step_granularity": result.p50_step_granularity,
             "dtype": cfg.compute_dtype,
+            # gradient-arm identity: A/B runs over these knobs must
+            # render as config drift, not as unexplained perf deltas
+            # (obs diff reads the same fields from the manifest config)
+            "variable_update": cfg.variable_update,
+            "fusion_threshold_bytes": cfg.fusion_threshold_bytes,
+            "overlap_grad_comm": cfg.overlap_grad_comm,
             # goodput ledger: the perf trajectory captures overlap wins
             # (compile/checkpoint blocking shrinking), not just the
             # images/sec headline (NaN-goodput runs carry null)

@@ -320,3 +320,59 @@ def test_world2_lowering_counts_definition_sites(devices):
     assert set(counts) == {"all-reduce"}
     assert counts["all-reduce"] == 3
     assert text.count("all-reduce") > counts["all-reduce"]
+
+
+def test_zero1_lowering_emits_reduce_scatter_all_gather(devices):
+    """The zero1 arm's compiled world=2 step must shard the gradient
+    path: reduce-scatter + all-gather present, all-reduce budget only
+    for the loss pmean — the program property the arm exists for.
+    Trivial member: cheap compile, no BN stats."""
+    text = hlo.lower_world_step_hlo(
+        "trivial", batch=2, world=2, variable_update="zero1",
+        fusion_threshold_bytes=256, num_classes=10)
+    counts = hlo.collective_counts(text)
+    assert counts.get("reduce-scatter", 0) >= 1
+    assert counts.get("all-gather", 0) >= 1
+    assert counts.get("all-reduce", 0) <= 1     # the scalar loss pmean
+
+
+def test_check_zero1_collectives_clean_and_loud():
+    """The lint wrapper: clean on the healthy arm; doctored count sets
+    produce collective-shape findings (the pure half, no compile)."""
+    from tpu_hc_bench.analysis import lints
+
+    assert lints.check_zero1_collectives(
+        "trivial", world=2, fusion_threshold_bytes=256) == []
+    # gradient path not sharded at all
+    got = lints.zero1_shape_findings("m", {"all-reduce": 5})
+    assert len(got) == 2 and all(f.lint == "collective-shape" for f in got)
+    assert "not optimizer-sharded" in got[0].message
+    # sharded, but gradient buckets ALSO riding a full all-reduce
+    got = lints.zero1_shape_findings(
+        "m", {"reduce-scatter": 4, "all-gather": 4, "all-reduce": 6})
+    assert len(got) == 1 and "full all-reduce" in got[0].message
+    # healthy: rs/ag pair + the loss pmean
+    assert lints.zero1_shape_findings(
+        "m", {"reduce-scatter": 2, "all-gather": 2, "all-reduce": 1}) == []
+
+
+def test_overlap_off_pins_optimization_barrier(devices):
+    """--overlap_grad_comm=off must compile the full-gradient-tree
+    barrier into the program (comm strictly after the complete
+    backward); on must not.  Asserted on the PRE-optimization text —
+    the CPU backend deletes opt-barrier during optimization (no latency
+    scheduling), the TPU pipeline schedules around it."""
+    on = hlo.lower_world_step_hlo(
+        "trivial", batch=2, world=2, fusion_threshold_bytes=256,
+        num_classes=10, optimize=False)
+    off = hlo.lower_world_step_hlo(
+        "trivial", batch=2, world=2, fusion_threshold_bytes=256,
+        num_classes=10, overlap_grad_comm="off", optimize=False)
+    assert "optimization_barrier" not in on
+    assert "optimization_barrier" in off
+    # zero1 honors the same flag
+    z_off = hlo.lower_world_step_hlo(
+        "trivial", batch=2, world=2, variable_update="zero1",
+        fusion_threshold_bytes=256, num_classes=10,
+        overlap_grad_comm="off", optimize=False)
+    assert "optimization_barrier" in z_off

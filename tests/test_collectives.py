@@ -107,6 +107,112 @@ def test_fused_empty_tree_is_noop(mesh8):
     assert collectives.fused_psum_tree({}) == {}
 
 
+def test_fused_psum_mixed_dtype_bucket_promotes_and_restores(mesh8):
+    """Regression (round 6): bf16 + f32 leaves grouped into ONE bucket
+    must promote to the wire ``jnp.result_type`` (f32) and restore each
+    leaf's original dtype/shape; leaves already at the wire dtype come
+    back bitwise."""
+    tree = {
+        "a_f32": jax.random.normal(jax.random.PRNGKey(1), (8, 3)),
+        "b_bf16": (jnp.arange(16.0).reshape(8, 2) / 7).astype(jnp.bfloat16),
+        "c_f32": jnp.linspace(0.0, 1.0, 8).reshape(8, 1),
+    }
+
+    def one_bucket(t):
+        return collectives.fused_psum_tree(t, threshold_bytes=1 << 20,
+                                           average=True)
+
+    def per_leaf(t):
+        return jax.tree.map(lambda g: jax.lax.pmean(g, DATA_AXIS), t)
+
+    fused = shard(mesh8, one_bucket)(tree)
+    ref = shard(mesh8, per_leaf)(tree)
+    for k in tree:
+        assert fused[k].dtype == tree[k].dtype
+        assert fused[k].shape == tree[k].shape
+    # f32 leaves rode the wire at their own dtype: bitwise vs plain pmean
+    np.testing.assert_array_equal(np.asarray(fused["a_f32"]),
+                                  np.asarray(ref["a_f32"]))
+    np.testing.assert_array_equal(np.asarray(fused["c_f32"]),
+                                  np.asarray(ref["c_f32"]))
+    # the bf16 leaf was promoted to the f32 wire (MORE precise than a
+    # bf16-wire pmean) then cast back: equals the f32 mean rounded once
+    want = np.asarray(
+        shard(mesh8, per_leaf)({"b": tree["b_bf16"].astype(jnp.float32)})
+        ["b"]).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(fused["b_bf16"]).astype(np.float32),
+        want.astype(np.float32))
+
+
+def test_fused_psum_same_dtype_bucket_bitwise(mesh8):
+    """A same-dtype bucket's pack/reduce/unpack is bitwise lossless:
+    fused result == per-leaf psum, element for element."""
+    tree = {"w": jax.random.normal(jax.random.PRNGKey(2), (8, 7)),
+            "b": jax.random.normal(jax.random.PRNGKey(3), (8, 2))}
+    fused = shard(
+        mesh8, lambda t: collectives.fused_psum_tree(
+            t, threshold_bytes=1 << 20))(tree)
+    ref = shard(
+        mesh8, lambda t: jax.tree.map(
+            lambda g: jax.lax.psum(g, DATA_AXIS), t))(tree)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(fused[k]),
+                                      np.asarray(ref[k]))
+
+
+def test_bucket_order_backward_vs_forward():
+    """overlap=on packs buckets in reversed (backward-completion) leaf
+    order; off keeps flatten order.  Membership changes, coverage never."""
+    leaves = [jnp.ones((n,), jnp.float32) for n in (10, 10, 10, 100, 2)]
+    fwd = collectives._flatten_to_buckets(
+        leaves, 80, collectives._bucket_order(len(leaves), overlap=False))
+    bwd = collectives._flatten_to_buckets(
+        leaves, 80, collectives._bucket_order(len(leaves), overlap=True))
+    assert [i for b in fwd for i in b] == list(range(5))
+    assert [i for b in bwd for i in b] == list(range(5))[::-1]
+    assert bwd[0][0] == 4           # last leaf's grad lands first
+    assert [3] in bwd               # oversized leaf still alone
+
+
+def test_reduce_scatter_all_gather_tree_roundtrip(mesh8):
+    """The ZeRO-1 wire pair: bucketed reduce-scatter shards then
+    all-gather reconstructs the per-leaf pmean exactly — odd leaf sizes
+    exercise the per-leaf padding, the small threshold multiple
+    buckets, and both overlap arms must agree bitwise."""
+    tree = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (8, 5, 3)),
+        "b": jnp.arange(8.0).reshape(8, 1),        # 1 elem/shard, pad 0
+        "t": jnp.ones((8, 3), jnp.bfloat16),
+    }
+
+    def ref(t):
+        return jax.tree.map(lambda g: jax.lax.pmean(g, DATA_AXIS), t)
+
+    outs = {}
+    for overlap in (True, False):
+        def rs_ag(t, ov=overlap):
+            shards = collectives.reduce_scatter_tree(
+                t, threshold_bytes=64, average=True, overlap=ov)
+            # every shard is 1-D of ceil(size/8) elements, leaf dtype
+            for leaf, s in zip(jax.tree.leaves(t), jax.tree.leaves(shards)):
+                assert s.shape == (collectives.zero1_shard_len(leaf.size, 8),)
+                assert s.dtype == leaf.dtype
+            return collectives.all_gather_tree(
+                shards, t, threshold_bytes=64, overlap=ov)
+
+        outs[overlap] = shard(mesh8, rs_ag)(tree)
+    want = shard(mesh8, ref)(tree)
+    for k in tree:
+        np.testing.assert_allclose(
+            np.asarray(outs[True][k], np.float32),
+            np.asarray(want[k], np.float32), rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(outs[True][k], np.float32),
+            np.asarray(outs[False][k], np.float32))
+        assert outs[True][k].shape == tree[k].shape
+
+
 def test_fused_psum_tree_dual_axis(devices):
     """Fusion buckets reduce over a tuple of mesh axes (the DP x SP path)."""
     from jax.sharding import Mesh

@@ -333,6 +333,103 @@ def test_driver_rejects_missing_ceiling_file(tmp_path):
         driver.run_benchmark(cfg, print_fn=lambda s: None)
 
 
+def _device_trace_events(ops):
+    """Minimal perfetto event list with a TPU device pid: ``ops`` are
+    (tid, name, ts, dur) X events."""
+    events = [{"ph": "M", "pid": 1, "name": "process_name",
+               "args": {"name": "/device:TPU:0"}}]
+    for tid, name, ts, dur in ops:
+        events.append({"ph": "X", "pid": 1, "tid": tid, "name": name,
+                       "ts": ts, "dur": dur})
+    return events
+
+
+def test_collective_overlap_exposed_fraction():
+    """The --overlap_grad_comm measurement: a collective hidden behind
+    concurrent compute on a sibling track is overlapped; one running
+    alone is exposed.  The overlapped trace must report a strictly
+    lower exposed fraction than the serialized one."""
+    from tpu_hc_bench.obs import trace as trace_mod
+
+    # serialized (off): backward compute [0,100), then the all-reduce
+    # [100,140) with the device otherwise idle
+    off = trace_mod.leaf_intervals(_device_trace_events([
+        (1, "fusion.backward", 0, 100),
+        (2, "all-reduce.1", 100, 40),
+    ]))
+    rec_off = efficiency.collective_overlap(off)
+    assert rec_off["exposed_frac"] == pytest.approx(1.0)
+    # overlapped (on): the same 40us of all-reduce, 30 of them under
+    # the still-running backward
+    on = trace_mod.leaf_intervals(_device_trace_events([
+        (1, "fusion.backward", 0, 100),
+        (2, "all-reduce.1", 70, 40),
+    ]))
+    rec_on = efficiency.collective_overlap(on)
+    assert rec_on["collective_us"] == pytest.approx(40.0)
+    assert rec_on["exposed_frac"] == pytest.approx(10.0 / 40.0)
+    assert rec_on["exposed_frac"] < rec_off["exposed_frac"]
+    assert rec_on["overlapped_frac"] == pytest.approx(30.0 / 40.0)
+    lines = efficiency.overlap_lines(rec_on)
+    assert "exposed" in lines[0] and "overlapped" in lines[0]
+    # no collectives at all -> None, not a zero-division
+    assert efficiency.collective_overlap(
+        [("fusion.fwd", 0.0, 10.0)]) is None
+
+
+def test_collective_busbw_absolute_lines():
+    """Satellite: achieved allreduce busbw in absolute GB/s with NO
+    ceiling sweep — same arithmetic as the ceiling line (100 MB over
+    30% of a 100ms step at world 8 -> 5.83 GB/s busbw)."""
+    summary = {"mean_step_ms": 100.0, "total_workers": 8,
+               "allreduce_bytes_per_step": 100 * 10**6}
+    trace = {"buckets": {"compute": 70.0, "collective": 30.0},
+             "steps": 2, "collective_ops": {"allreduce": 30.0}}
+    text = "\n".join(efficiency.collective_busbw_lines(summary, trace))
+    assert "5.83 GB/s busbw" in text
+    assert "absolute" in text
+    # the zero1 arm's split collectives fold into the same figure — and
+    # a realistic zero1 trace ALSO carries a tiny loss-pmean all-reduce,
+    # which must sum into the denominator, not replace it (the 0.5us
+    # all-reduce alone would report thousands of GB/s)
+    z = {"buckets": {"compute": 70.0, "collective": 30.0},
+         "collective_ops": {"reduce_scatter": 18.0, "all_gather": 12.0}}
+    assert "5.83 GB/s busbw" in "\n".join(
+        efficiency.collective_busbw_lines(summary, z))
+    z2 = {"buckets": {"compute": 70.0, "collective": 30.0},
+          "collective_ops": {"allreduce": 0.5, "reduce_scatter": 18.0,
+                             "all_gather": 11.5}}
+    assert "5.83 GB/s busbw" in "\n".join(
+        efficiency.collective_busbw_lines(summary, z2))
+    # degradations stay silent (the ceiling path owns the loud lines)
+    assert efficiency.collective_busbw_lines(summary, None) == []
+    assert efficiency.collective_busbw_lines(
+        dict(summary, total_workers=1), trace) == []
+
+
+def test_summarize_prints_busbw_and_overlap_without_ceiling(tmp_path):
+    """obs summarize on a run with trace buckets but NO --fabric_ceiling
+    must print the absolute busbw line (previously ceiling-gated) and
+    the collective-exposure attribution when the record carries one."""
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "manifest.json").write_text('{"schema": 1, "model": "trivial"}\n')
+    (d / "metrics.jsonl").write_text(
+        '{"kind": "summary", "mean_step_ms": 100.0, "total_workers": 8, '
+        '"allreduce_bytes_per_step": 100000000, "mfu": 0.3, '
+        '"mfu_source": "measured"}\n'
+        '{"kind": "trace_buckets", '
+        '"buckets": {"compute": 70.0, "collective": 30.0}, "steps": 2, '
+        '"collective_ops": {"allreduce": 30.0}, '
+        '"overlap": {"collective_us": 40.0, "exposed_us": 10.0, '
+        '"exposed_frac": 0.25, "overlapped_frac": 0.75}}\n')
+    out = io.StringIO()
+    assert obs_main(["summarize", str(d)], out=out) == 0
+    text = out.getvalue()
+    assert "GB/s busbw" in text
+    assert "collective exposure: 25.0%" in text
+
+
 def test_osu_sweep_json_roundtrip(tmp_path):
     from tpu_hc_bench.microbench import osu
 

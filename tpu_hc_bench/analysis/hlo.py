@@ -278,9 +278,16 @@ def op_attribution(module: HloModule, opcodes: tuple[str, ...] = ("dot",),
 
 def lower_world_step_hlo(model_name: str, batch: int = 2,
                          world: int = 2, attention_impl: str = "dense",
-                         moe_impl: str = "einsum",
+                         moe_impl: str = "einsum", optimize: bool = True,
                          **config_overrides) -> str:
     """Optimized-HLO text of the zoo member's compiled world=N train step.
+
+    ``optimize=False`` returns the pre-optimization (StableHLO) text of
+    the lowered step instead — needed for program properties the CPU
+    backend erases during optimization (e.g. the ``optimization_barrier``
+    the ``--overlap_grad_comm=off`` arm pins across the gradient tree:
+    the TPU pipeline schedules around it, the CPU pipeline deletes it),
+    and cheaper when no compile is needed.
 
     A ``world``-virtual-device single-process data mesh compiles the
     identical program a ``world``-process run executes (same mesh shape,
@@ -323,12 +330,19 @@ def lower_world_step_hlo(model_name: str, batch: int = 2,
     else:
         raw = SyntheticImages(batch * world, spec.input_shape,
                               num_classes=cfg.num_classes).batch()
-    state = step_mod.make_train_state(model, cfg, raw)
-    state = step_mod.replicate_state(state, mesh)
+    if cfg.variable_update == "zero1":
+        # zero1 states carry stacked [world, k] optimizer leaves sharded
+        # over the data axis — the layout the step's in_specs name
+        state = step_mod.make_zero1_state(model, cfg, raw, world)
+        state = step_mod.place_zero1_state(state, mesh)
+    else:
+        state = step_mod.make_train_state(model, cfg, raw)
+        state = step_mod.replicate_state(state, mesh)
     dev_batch = step_mod.shard_batch(raw, mesh)
     step_fn = step_mod.build_train_step(mesh, cfg, spec)
     # the builder returns a wrapper around its jitted shard_map; jitting
     # the wrapper inlines it, giving a lowerable handle on the SAME program
-    compiled = (jax.jit(step_fn)
-                .lower(state, dev_batch, jax.random.PRNGKey(0)).compile())
-    return compiled.as_text()
+    lowered = jax.jit(step_fn).lower(state, dev_batch, jax.random.PRNGKey(0))
+    if not optimize:
+        return lowered.as_text()
+    return lowered.compile().as_text()

@@ -47,13 +47,14 @@ from tpu_hc_bench.analysis.report import Finding
 
 __all__ = [
     "lint_source_text", "lint_file", "lint_repo_sources", "lint_model",
-    "ALL_SOURCE_LINTS",
+    "check_zero1_collectives", "ALL_SOURCE_LINTS",
 ]
 
 HOST_SYNC = "host-sync-in-jit"
 RECOMPILE = "recompile-hazard"
 DONATION = "donated-buffer-misuse"
 SHARDING = "sharding-consistency"
+COLLECTIVE_SHAPE = "collective-shape"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION)
 
 # callables whose function-valued arguments are traced (jit contexts)
@@ -634,6 +635,63 @@ def check_jaxpr_host_callbacks(name: str) -> list[Finding]:
                             walk(item.jaxpr, depth + 1)
 
     walk(jaxpr.jaxpr)
+    return findings
+
+
+def check_zero1_collectives(name: str = "trivial", world: int = 2,
+                            batch: int = 2,
+                            **config_overrides) -> list[Finding]:
+    """HLO check for the zero1 arm's collective shape.
+
+    Lowers the member's world=N ``--variable_update=zero1`` train step
+    and asserts the GRADIENT path compiled to reduce-scatter +
+    all-gather, not a full all-reduce — the program property the arm
+    exists for (half the ring traffic per direction, sharded update in
+    between).  A small all-reduce budget remains legitimate: the loss
+    ``pmean`` and, for BN members, the batch-stat sync; a gradient tree
+    silently falling back to all-reduce blows well past it.  Findings
+    are ``collective-shape`` errors, empty when the arm is healthy —
+    the same accept-into-baseline contract as every other lint.
+    """
+    from tpu_hc_bench.analysis import hlo
+
+    config_overrides.setdefault("num_classes", 10)
+    text = hlo.lower_world_step_hlo(
+        name, batch=batch, world=world, variable_update="zero1",
+        **config_overrides)
+    return zero1_shape_findings(
+        name, hlo.collective_counts(text),
+        location=f"hlo:{name}:zero1:world{world}")
+
+
+def zero1_shape_findings(name: str, counts: dict[str, int],
+                         location: str = "hlo:") -> list[Finding]:
+    """The pure half of ``check_zero1_collectives``: derive findings
+    from definition-site collective counts (unit-testable without a
+    compile)."""
+    rs = counts.get("reduce-scatter", 0)
+    ag = counts.get("all-gather", 0)
+    ar = counts.get("all-reduce", 0)
+    findings: list[Finding] = []
+    loc = location
+    if rs < 1 or ag < 1:
+        findings.append(Finding(
+            lint=COLLECTIVE_SHAPE, severity="error", model=name,
+            location=loc,
+            message=f"zero1 step lowered without the reduce-scatter/"
+                    f"all-gather pair (counts: {counts}) — the gradient "
+                    "path is not optimizer-sharded"))
+    # non-gradient all-reduces: the scalar loss pmean (1) plus the
+    # BN-stat sync bucket(s) — a small fixed budget.  A gradient tree
+    # falling back to all-reduce adds one per GRAD bucket and blows it.
+    budget = 3
+    if ar > budget:
+        findings.append(Finding(
+            lint=COLLECTIVE_SHAPE, severity="error", model=name,
+            location=loc,
+            message=f"zero1 step emits {ar} all-reduces (> budget "
+                    f"{budget} for loss/BN-stat sync; counts: {counts}) "
+                    "— gradient buckets are riding a full all-reduce"))
     return findings
 
 

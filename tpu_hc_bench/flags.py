@@ -21,8 +21,10 @@ interpretation would be wrong for the hardware:
 - ``--variable_update=horovod --horovod_device=cpu
   --local_parameter_device=cpu`` (reference :77-79): the reference's
   data-parallel engine selection.  Here ``variable_update`` accepts
-  ``horovod|psum|replicated`` and maps to gradient ``psum`` over the mesh's
-  data axis (the TPU-native equivalent of Horovod's fused MPI allreduce).
+  ``horovod|psum|replicated|zero1`` and maps to gradient ``psum`` over the
+  mesh's data axis (the TPU-native equivalent of Horovod's fused MPI
+  allreduce); ``zero1`` is the ZeRO-1 optimizer-state-sharding arm
+  (reduce-scatter + sharded update + all-gather, train/step.py).
 
 Defaults mirror the constants hardcoded in the reference launcher
 (``run-tf-sing-ucx-openmpi.sh:32-35``): 50 warmup batches, 100 timed batches,
@@ -159,6 +161,17 @@ class BenchmarkConfig:
 
     # --- TPU-native additions (no reference analog) ---
     fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    overlap_grad_comm: str = "on"             # psum/zero1 arms: pack the
+                                              # gradient fusion buckets in
+                                              # backward-completion order
+                                              # so XLA's async collectives
+                                              # overlap the remaining
+                                              # backward ("on", default);
+                                              # "off" barriers the full
+                                              # grad tree first — comm
+                                              # strictly after the
+                                              # complete backward (the
+                                              # serialized A/B control)
     seed: int = 0
     num_classes: int = 1000                   # imagenet label space
     trace_dir: str | None = None              # jax.profiler trace output; the
@@ -358,6 +371,30 @@ class BenchmarkConfig:
         if self.variable_update == "horovod":
             t["variable_update"] = "horovod->psum (XLA allreduce over mesh)"
             self.variable_update = "psum"
+        if self.variable_update == "zero1":
+            # ZeRO-1 shards the optimizer state over the data axis; every
+            # unsupported composition dies at flag time, not 50 warmup
+            # steps in
+            if self.model_parallel > 1 or self.expert_parallel > 1:
+                raise ValueError(
+                    "--variable_update=zero1 composes with plain data "
+                    "parallelism only (TP/EP run on the GSPMD arm)")
+            if self.pipeline_parallel > 1:
+                raise ValueError(
+                    "--variable_update=zero1 is not supported with "
+                    "--pipeline_parallel (the GPipe arm owns its own "
+                    "gradient path; no sharded-optimizer layout)")
+            if (self.sequence_parallel > 1
+                    or self.attention_impl in SEQ_SHARDED_IMPLS):
+                raise ValueError(
+                    "--variable_update=zero1 composes with plain data "
+                    "parallelism only: the SP step reduces over "
+                    "(data, seq) and the zero1 reduce-scatter layout is "
+                    "data-axis only")
+            if self.forward_only:
+                raise ValueError(
+                    "--variable_update=zero1 shards the OPTIMIZER state; "
+                    "forward-only runs have none (use psum)")
         if self.horovod_device in ("cpu", "gpu"):
             t["horovod_device"] = f"{self.horovod_device}->tpu"
             self.horovod_device = "tpu"
@@ -426,7 +463,8 @@ class BenchmarkConfig:
                 # rejects
                 raise ValueError(
                     "--gradient_accumulation_steps needs "
-                    "--variable_update=psum (the explicit-psum step)")
+                    "--variable_update=psum or zero1 (the explicit "
+                    "shard_map step)")
             if self.forward_only or self.eval:
                 raise ValueError(
                     "--gradient_accumulation_steps is a training-step "
@@ -616,6 +654,19 @@ class BenchmarkConfig:
                 f"fusion_threshold do not apply)"
             )
             self.variable_update = "replicated"
+        if self.overlap_grad_comm not in ("on", "off"):
+            raise ValueError(
+                f"--overlap_grad_comm must be on|off: "
+                f"{self.overlap_grad_comm!r}")
+        if (self.overlap_grad_comm == "off"
+                and self.variable_update == "replicated"
+                and self.pipeline_parallel == 1):
+            # the GSPMD arm's collectives are scheduled by XLA; the flag
+            # only shapes the explicit psum/zero1 programs — record the
+            # no-op instead of silently accepting it
+            t["overlap_grad_comm"] = (
+                "off->n/a (GSPMD schedules its own collectives; the flag "
+                "applies to the psum/zero1 arms)")
         self.translations = t
         return self
 
@@ -633,6 +684,8 @@ class BenchmarkConfig:
             + f" prefetch_depth={self.prefetch_depth}",
             f"variable_update={self.variable_update} "
             f"fusion_threshold={self.fusion_threshold_bytes}B"
+            + (f" overlap_grad_comm={self.overlap_grad_comm}"
+               if self.variable_update in ("psum", "zero1") else "")
             + (f" model_parallel={self.model_parallel}"
                if self.model_parallel > 1 else "")
             + (f" expert_parallel={self.expert_parallel}"
@@ -681,7 +734,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mkl", type=_parse_bool, default=False)
     p.add_argument("--use_fp16", type=_parse_bool, default=False)
     p.add_argument("--variable_update", type=str, default="psum",
-                   choices=["horovod", "psum", "replicated"])
+                   choices=["horovod", "psum", "replicated", "zero1"])
+    p.add_argument("--overlap_grad_comm", type=str, default=d.overlap_grad_comm,
+                   choices=["on", "off"])
     p.add_argument("--horovod_device", type=str, default=d.horovod_device)
     p.add_argument("--local_parameter_device", type=str,
                    default=d.local_parameter_device)

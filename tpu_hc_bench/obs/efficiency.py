@@ -260,6 +260,131 @@ def load_fabric_ceiling(path: str) -> dict:
             "ceilings": ceilings}
 
 
+def _merge_intervals(
+    intervals: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    """Sorted disjoint union of [start, end) intervals."""
+    out: list[tuple[float, float]] = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _intersection_len(a: list[tuple[float, float]],
+                      b: list[tuple[float, float]]) -> float:
+    """Total overlap length of two sorted disjoint interval lists."""
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def collective_overlap(
+        intervals: list[tuple[str, float, float]]) -> dict | None:
+    """Overlapped-vs-exposed collective attribution from trace intervals.
+
+    ``intervals`` is ``obs.trace.leaf_intervals``'s output.  *Exposed*
+    collective wall is the part of the collective-busy span no
+    compute/host-transfer op covers concurrently (a sibling track's DMA
+    or MXU work hides a collective; a collective running alone is pure
+    step-time cost).  This is the measurement behind
+    ``--overlap_grad_comm``: the flag's win is exposed fraction going
+    DOWN while total collective time stays ~flat.  Same ratio-only
+    trust contract as every trace consumer (obs.trace docstring).
+    Returns None when the trace has no collective ops.
+    """
+    from tpu_hc_bench.obs import trace as trace_mod
+
+    coll: list[tuple[float, float]] = []
+    comp: list[tuple[float, float]] = []
+    for name, s, e in intervals:
+        if e <= s:
+            continue
+        if trace_mod.bucket_of(name) == "collective":
+            coll.append((s, e))
+        else:
+            comp.append((s, e))
+    if not coll:
+        return None
+    coll_u = _merge_intervals(coll)
+    comp_u = _merge_intervals(comp)
+    total = sum(e - s for s, e in coll_u)
+    covered = _intersection_len(coll_u, comp_u)
+    exposed = max(0.0, total - covered)
+    frac = exposed / total if total > 0 else 0.0
+    return {
+        "collective_us": total,
+        "exposed_us": exposed,
+        "exposed_frac": frac,
+        "overlapped_frac": 1.0 - frac,
+    }
+
+
+def overlap_lines(rec: dict) -> list[str]:
+    """Render a ``collective_overlap`` record (driver + summarize)."""
+    return [
+        f"  collective exposure: {rec.get('exposed_frac', 0.0):.1%} of "
+        f"collective wall exposed, {rec.get('overlapped_frac', 0.0):.1%} "
+        f"overlapped with compute"
+    ]
+
+
+def collective_busbw_lines(summary: dict,
+                           trace_rec: dict | None) -> list[str]:
+    """Absolute achieved gradient-collective bus bandwidth (GB/s).
+
+    The ceiling-free companion of ``ceiling_utilization_lines``: the
+    same trace-ratio x wall-step-time x wire-bytes derivation, printed
+    in absolute GB/s so a run WITHOUT a ``--fabric_ceiling`` sweep still
+    reports what the fabric achieved instead of gating the number on an
+    artifact the operator may not have.  The zero1 arm's reduce-scatter
+    + all-gather pair is folded into the same figure (together they move
+    the allreduce's ring volume over the same gradient bytes).
+    """
+    if not trace_rec or not trace_rec.get("buckets"):
+        return []
+    buckets = trace_rec["buckets"]
+    total_us = sum(buckets.values())
+    if total_us <= 0 or buckets.get("collective", 0.0) <= 0:
+        return []
+    mean_step_s = summary.get("mean_step_ms", 0.0) / 1e3
+    world = int(summary.get("total_workers") or 0)
+    bytes_per_step = summary.get("allreduce_bytes_per_step")
+    if mean_step_s <= 0 or world <= 1 or not bytes_per_step:
+        return []
+    coll_ops = trace_rec.get("collective_ops") or {
+        "allreduce": buckets["collective"]}
+    # every gradient-carrying kind, summed: the psum arm's all-reduce
+    # buckets, the zero1 arm's reduce-scatter + all-gather pair (a zero1
+    # trace ALSO has a small all-reduce — the loss pmean/BN-stat sync —
+    # which must not become the denominator on its own)
+    grad_us = (coll_ops.get("allreduce", 0.0)
+               + coll_ops.get("reduce_scatter", 0.0)
+               + coll_ops.get("all_gather", 0.0))
+    if grad_us <= 0:
+        return []
+    frac = grad_us / total_us
+    sec_per_step = frac * mean_step_s
+    algbw = bytes_per_step / sec_per_step / 1e9
+    busbw = algbw * 2.0 * (world - 1) / world
+    return [
+        f"  fabric: gradient collectives {busbw:.2f} GB/s busbw "
+        f"({algbw:.2f} GB/s algbw, {frac:.1%} of step time, "
+        f"{bytes_per_step / 2**20:.1f} MiB/step; absolute — pass "
+        f"--fabric_ceiling for %-of-measured-ceiling)"
+    ]
+
+
 def collective_kind_times(op_times: dict[str, float]) -> dict[str, float]:
     """Fold leaf-op durations into sweep-op kinds (all-reduce leaves of
     any fusion spelling -> "allreduce", ...)."""

@@ -434,12 +434,21 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
                           for k, v in sorted(tb["buckets"].items(),
                                              key=lambda kv: -kv[1]))
         lines.append(f"  trace buckets: {parts}")
-    if fabric_ceiling:
-        from tpu_hc_bench.obs import efficiency as eff_mod
+    from tpu_hc_bench.obs import efficiency as eff_mod
 
+    if tb and tb.get("overlap"):
+        # --overlap_grad_comm attribution: how much of the collective
+        # wall ran exposed vs hidden behind concurrent compute
+        lines.extend(eff_mod.overlap_lines(tb["overlap"]))
+    if fabric_ceiling:
         ceiling = eff_mod.load_fabric_ceiling(fabric_ceiling)
         lines.extend(eff_mod.ceiling_utilization_lines(
             summary or {}, tb, ceiling))
+    else:
+        # no sweep supplied: still report the achieved gradient-
+        # collective bandwidth in absolute GB/s (previously this line
+        # was ceiling-gated and a sweep-less run printed nothing)
+        lines.extend(eff_mod.collective_busbw_lines(summary or {}, tb))
     return lines
 
 

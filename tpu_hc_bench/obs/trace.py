@@ -164,6 +164,18 @@ def leaf_device_ops(
     return _aggregate(leaves)
 
 
+def leaf_intervals(events: list[dict]) -> list[tuple[str, float, float]]:
+    """``(name, start_us, end_us)`` for every leaf device op, the
+    step-marker track excluded — the interval-level view
+    ``obs.efficiency.collective_overlap`` needs to tell an *exposed*
+    collective (device otherwise idle) from one hidden behind concurrent
+    compute on a sibling track."""
+    tracks = _device_tracks(events)
+    st = _step_track(events, tracks)
+    leaves, _ = _split_tracks(tracks, {st} if st is not None else None)
+    return [(e["name"], e["ts"], e["ts"] + e["dur"]) for e in leaves]
+
+
 def device_op_times(trace_dir: str) -> tuple[dict[str, float],
                                              dict[str, int]]:
     """Aggregate device-track op durations (us) + event counts from the

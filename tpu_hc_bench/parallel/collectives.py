@@ -10,6 +10,33 @@ concatenated into buckets of at most ``fusion_threshold_bytes`` so each
 ``psum`` moves one large contiguous buffer over ICI instead of many small
 ones (latency-bound -> bandwidth-bound, exactly Horovod's trick).
 
+Communication/compute **overlap** (round 6): a bucket's collective is
+data-dependent only on the gradients it carries, so XLA's async
+collectives can run it concurrently with the *rest* of the backward pass
+— but only if the program gives the scheduler that freedom.  Two things
+here do:
+
+- ``overlap=True`` (the default) packs buckets in REVERSE flatten order.
+  Tree-flatten order tracks forward/layer order for the zoo's models, so
+  reversed order is backward-completion order: the last layers' grads —
+  produced FIRST in the backward — fill the first buckets, and each
+  bucket's collective can start while earlier layers are still
+  differentiating.  (Forward-order packing puts a late-completing leaf
+  in the first bucket and serializes everything behind it.)
+- ``overlap=False`` pins an ``optimization_barrier`` across the whole
+  gradient tree before the first collective — the explicit
+  "allreduce after the full backward pass" arm (exactly what a
+  post-``value_and_grad`` Horovod hook does), kept as the A/B control
+  for ``--overlap_grad_comm``.
+
+``reduce_scatter_tree`` / ``all_gather_tree`` are the ZeRO-1 wire pair
+(``--variable_update=zero1``): the same buckets, but each bucket moves a
+reduce-scatter (every device receives only its 1/N shard of the summed
+gradients) and, after the sharded optimizer update, an all-gather of the
+updated parameter shards.  Leaves are padded per-leaf to the axis size,
+so the shard layout is threshold-independent (checkpoints survive a
+``--fusion_threshold_bytes`` change).
+
 These helpers must be called inside a ``jax.shard_map``-ed (or otherwise
 mesh-mapped) function where ``axis_name`` is bound.
 """
@@ -57,17 +84,22 @@ def ppermute_ring(x: Any, axis_name: str = DATA_AXIS, shift: int = 1) -> Any:
 
 
 def _flatten_to_buckets(
-    leaves: Sequence[jax.Array], threshold_bytes: int
+    leaves: Sequence[jax.Array], threshold_bytes: int,
+    order: Sequence[int] | None = None,
 ) -> list[list[int]]:
     """Greedily group leaf indices into buckets of <= threshold bytes.
 
     A leaf larger than the threshold gets its own bucket (Horovod does the
-    same: oversized tensors bypass the fusion buffer).
+    same: oversized tensors bypass the fusion buffer).  ``order`` packs
+    the leaves in that index order (default: flatten order); the overlap
+    path passes reverse order so each bucket holds gradients that become
+    available together during the backward pass.
     """
     buckets: list[list[int]] = []
     cur: list[int] = []
     cur_bytes = 0
-    for i, leaf in enumerate(leaves):
+    for i in (order if order is not None else range(len(leaves))):
+        leaf = leaves[i]
         nbytes = leaf.size * leaf.dtype.itemsize
         if cur and cur_bytes + nbytes > threshold_bytes:
             buckets.append(cur)
@@ -82,25 +114,55 @@ def _flatten_to_buckets(
     return buckets
 
 
+def _bucket_order(num_leaves: int, overlap: bool) -> list[int]:
+    """Bucket packing order: backward-completion (reversed flatten) order
+    when overlapping, flatten order otherwise."""
+    idx = list(range(num_leaves))
+    return idx[::-1] if overlap else idx
+
+
+def _serialize_after_backward(leaves: list[jax.Array],
+                              overlap: bool) -> list[jax.Array]:
+    """The ``overlap=False`` control arm: an optimization barrier across
+    the FULL gradient tree, so no collective can be scheduled before the
+    last gradient exists — communication strictly follows the complete
+    backward pass, the behavior ``--overlap_grad_comm=off`` selects."""
+    if overlap or not leaves:
+        return leaves
+    return list(jax.lax.optimization_barrier(tuple(leaves)))
+
+
 def fused_psum_tree(
     tree: Any,
     axis_name: str | tuple[str, ...] = DATA_AXIS,
     threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
     average: bool = False,
+    overlap: bool = True,
 ) -> Any:
     """Allreduce a pytree through fusion buckets — Horovod fusion-buffer port.
 
     Leaves are flattened, concatenated per-bucket (grouped greedily up to
-    ``threshold_bytes``, preserving order), reduced with one ``psum`` per
-    bucket, then split and reshaped back.  Mixed dtypes within a bucket are
-    upcast to the widest float dtype for the wire and cast back on unpack.
+    ``threshold_bytes``), reduced with one ``psum`` per bucket, then split
+    and reshaped back.  Mixed dtypes within a bucket are upcast to the
+    widest float dtype (``jnp.result_type``) for the wire and cast back on
+    unpack — bitwise lossless for the leaves already at the wire dtype.
     ``axis_name`` may be a tuple of bound mesh axes (e.g. the DP x SP
     step reduces over both).
+
+    ``overlap`` selects bucket-packing order and scheduling freedom (see
+    module docstring): ``True`` packs in backward-completion order so
+    XLA's async collectives can run concurrently with the remaining
+    backward compute; ``False`` barriers the full tree first — the
+    serialized control arm.  Bucketing never changes the VALUES (each
+    element's cross-device sum is the same in any bucket), only the
+    schedule.
     """
     leaves, treedef = jax.tree.flatten(tree)
     if not leaves:
         return tree
-    buckets = _flatten_to_buckets(leaves, threshold_bytes)
+    leaves = _serialize_after_backward(leaves, overlap)
+    buckets = _flatten_to_buckets(leaves, threshold_bytes,
+                                  _bucket_order(len(leaves), overlap))
     names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     denom = 1
     if average:
@@ -132,16 +194,130 @@ def allreduce_gradients(
     axis_name: str | tuple[str, ...] = DATA_AXIS,
     threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
     fuse: bool = True,
+    overlap: bool = True,
 ) -> Any:
     """The Horovod DistributedOptimizer step: average grads across workers.
 
     ``fuse=True`` routes through the fusion buckets; ``fuse=False`` emits one
     ``pmean`` per leaf and leaves combining to XLA (useful for A/B-ing the
     fusion port against the compiler, which is the honest TPU default).
+    ``overlap`` is the ``--overlap_grad_comm`` arm (see fused_psum_tree);
+    the unfused path only honors its ``False`` barrier (per-leaf pmeans
+    are already maximally schedulable).
     """
     if fuse:
         return fused_psum_tree(
             grads, axis_name=axis_name, threshold_bytes=threshold_bytes,
-            average=True,
+            average=True, overlap=overlap,
         )
-    return jax.tree.map(lambda g: jax.lax.pmean(g, axis_name), grads)
+    leaves, treedef = jax.tree.flatten(grads)
+    leaves = _serialize_after_backward(leaves, overlap)
+    return jax.tree.unflatten(
+        treedef, [jax.lax.pmean(g, axis_name) for g in leaves])
+
+
+# ---------------------------------------------------------------------
+# ZeRO-1 wire pair: bucketed reduce-scatter + all-gather over a pytree
+
+
+def zero1_shard_len(size: int, num_shards: int) -> int:
+    """Per-device shard length of a ``size``-element leaf: ceil-divided,
+    so every leaf pads to ``num_shards * shard_len`` (layout is
+    threshold-independent — only a function of leaf shapes and N)."""
+    return -(-size // num_shards)
+
+
+def _leaf_to_rows(leaf: jax.Array, num_shards: int, wire_dtype) -> jax.Array:
+    """Pad a leaf to ``num_shards * k`` and reshape ``[num_shards, k]`` —
+    row ``i`` is device ``i``'s shard of the flattened leaf."""
+    k = zero1_shard_len(leaf.size, num_shards)
+    flat = leaf.astype(wire_dtype).reshape(-1)
+    pad = num_shards * k - leaf.size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(num_shards, k)
+
+
+def reduce_scatter_tree(
+    tree: Any,
+    axis_name: str = DATA_AXIS,
+    threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
+    average: bool = False,
+    overlap: bool = True,
+) -> Any:
+    """Bucketed gradient reduce-scatter: the ZeRO-1 half-allreduce.
+
+    Each leaf is padded to the axis size and laid out ``[N, k]`` (row i =
+    device i's shard); a bucket concatenates its leaves' rows along the
+    shard dim and moves ONE ``psum_scatter`` — after which every device
+    holds only its 1/N shard of each summed gradient, at half the ring
+    traffic of the full allreduce.  Returns a pytree matching ``tree``
+    whose leaves are 1-D per-device shards of length
+    ``zero1_shard_len(leaf.size, N)``, cast back to the leaf dtype.
+    ``overlap`` follows fused_psum_tree's contract.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    n = jax.lax.axis_size(axis_name)
+    leaves = _serialize_after_backward(leaves, overlap)
+    buckets = _flatten_to_buckets(leaves, threshold_bytes,
+                                  _bucket_order(len(leaves), overlap))
+    out: list[jax.Array | None] = [None] * len(leaves)
+    for bucket in buckets:
+        wire_dtype = jnp.result_type(*[leaves[i].dtype for i in bucket])
+        rows = jnp.concatenate(
+            [_leaf_to_rows(leaves[i], n, wire_dtype) for i in bucket],
+            axis=1)
+        reduced = jax.lax.psum_scatter(
+            rows, axis_name, scatter_dimension=0, tiled=True
+        ).reshape(-1)
+        if average:
+            reduced = reduced / n
+        offset = 0
+        for i in bucket:
+            k = zero1_shard_len(leaves[i].size, n)
+            out[i] = reduced[offset:offset + k].astype(leaves[i].dtype)
+            offset += k
+    return jax.tree.unflatten(treedef, out)
+
+
+def all_gather_tree(
+    shard_tree: Any,
+    template_tree: Any,
+    axis_name: str = DATA_AXIS,
+    threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES,
+    overlap: bool = True,
+) -> Any:
+    """The ZeRO-1 return leg: bucketed all-gather of per-device 1-D leaf
+    shards (``reduce_scatter_tree``'s layout) back into full leaves with
+    ``template_tree``'s shapes/dtypes.  Bucket membership mirrors the
+    scatter's, so each bucket's update→gather chain depends only on its
+    own shards and can overlap other buckets' remaining backward/update
+    work.
+    """
+    shards, treedef = jax.tree.flatten(shard_tree)
+    templates = jax.tree.leaves(template_tree)
+    if not shards:
+        return shard_tree
+    n = jax.lax.axis_size(axis_name)
+    buckets = _flatten_to_buckets(templates, threshold_bytes,
+                                  _bucket_order(len(templates), overlap))
+    out: list[jax.Array | None] = [None] * len(shards)
+    for bucket in buckets:
+        flat = jnp.concatenate([shards[i].reshape(-1) for i in bucket])
+        gathered = jax.lax.all_gather(
+            flat, axis_name, axis=0, tiled=True
+        ).reshape(n, -1)
+        offset = 0
+        for i in bucket:
+            t = templates[i]
+            k = zero1_shard_len(t.size, n)
+            out[i] = (
+                gathered[:, offset:offset + k]
+                .reshape(-1)[:t.size]
+                .reshape(t.shape)
+                .astype(t.dtype)
+            )
+            offset += k
+    return jax.tree.unflatten(treedef, out)

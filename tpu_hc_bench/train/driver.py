@@ -725,7 +725,12 @@ def run_benchmark(
                 "DP-layout checkpoints); restore requires a filesystem "
                 "shared by all hosts")
         else:
-            sharded_ckpt = max(tp, ep) > 1
+            # zero1's optimizer state is sharded over the data axis: at
+            # world > 1 the shards span hosts and the host-gather save
+            # path cannot address them — the sharded Orbax path (restore
+            # AFTER placement) handles it like the TP/EP states
+            sharded_ckpt = (max(tp, ep) > 1
+                            or cfg.variable_update == "zero1")
             print_fn(
                 "--train_dir multi-process: "
                 + ("sharded Orbax I/O, every process writes its shards"
@@ -882,14 +887,27 @@ def run_benchmark(
     # records are already globally aggregated (psum'd loss, global-batch
     # rates), so its view is the merged record
     if cfg.metrics_dir and jax.process_index() == 0:
+        manifest_extra: dict = {}
+        if compile_cache_dir:
+            manifest_extra["compile_cache"] = {
+                "dir": compile_cache_dir,
+                "entries_before": cache_entries_before}
+        if cfg.variable_update == "zero1":
+            # manifest-noted checkpoint policy: single-process zero1
+            # saves gather the sharded optimizer state to host
+            # (gather-on-save); multi-process uses sharded Orbax I/O.
+            # No --train_dir = no checkpoints = no policy to note.
+            zrec: dict = {"opt_state_sharded": True,
+                          "opt_shards": layout.total_workers}
+            if cfg.train_dir:
+                zrec["checkpoint"] = ("sharded" if sharded_ckpt
+                                      else "gather-on-save")
+            manifest_extra["zero1"] = zrec
         obs_writer = obs_metrics.MetricsWriter(
             cfg.metrics_dir,
             obs_metrics.run_manifest(
                 cfg=cfg, layout=layout, mesh=mesh, fabric=fab.value,
-                extra=({"compile_cache": {
-                            "dir": compile_cache_dir,
-                            "entries_before": cache_entries_before}}
-                       if compile_cache_dir else None)),
+                extra=manifest_extra or None),
             primary=True)
         print_fn(f"metrics: {cfg.metrics_dir}/{obs_metrics.METRICS_NAME} "
                  f"(+ {obs_metrics.MANIFEST_NAME}); live view: "
@@ -1215,12 +1233,35 @@ def run_benchmark(
 
         batch_iter = batches()
     else:
-        state = step_mod.make_train_state(model, cfg, batch)
+        zero1 = cfg.variable_update == "zero1"
+        if zero1:
+            # the compositions flags.resolve can't see (fabric, slices)
+            # die here, before any state is built
+            if fab is fabric_mod.Fabric.HOST:
+                raise ValueError(
+                    "--variable_update=zero1 needs a device fabric "
+                    "(ici): the host path has no sharded optimizer")
+            if num_slices > 1:
+                raise ValueError(
+                    "--variable_update=zero1 composes with single-slice "
+                    "data parallelism only (no multislice reduce-scatter "
+                    "layout yet)")
+            print_fn(
+                f"zero1: optimizer state sharded {layout.total_workers}"
+                f"-way over the data axis (reduce-scatter + sharded "
+                f"update + all-gather; overlap_grad_comm="
+                f"{cfg.overlap_grad_comm})")
+            state = step_mod.make_zero1_state(model, cfg, batch,
+                                              layout.total_workers)
+        else:
+            state = step_mod.make_train_state(model, cfg, batch)
         if not sharded_ckpt:
             state, restored = _maybe_restore(state, cfg, print_fn)
         if mp > 1:
             mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
             place_fn = lambda s, m=mode: step_mod.shard_state_tp(s, mesh, m)
+        elif zero1:
+            place_fn = lambda s: step_mod.place_zero1_state(s, mesh)
         else:
             place_fn = lambda s: step_mod.replicate_state(s, mesh)
         state = place_fn(state)
@@ -1811,13 +1852,27 @@ def run_benchmark(
         # per-collective-kind split so the ceiling attribution can name
         # the collective, not just "collective time"
         coll_ops: dict[str, float] = {}
+        overlap_rec = None
         try:
-            ops, _ = obs_trace.device_op_times(cfg.trace_dir)
+            # ONE trace load + track split serves both consumers
+            # (profile traces run to hundreds of MB of JSON): the
+            # per-kind durations fold from the same leaf intervals the
+            # --overlap_grad_comm exposure attribution walks
+            intervals = obs_trace.leaf_intervals(
+                obs_trace.load_events(cfg.trace_dir))
+            ops: dict[str, float] = {}
+            for name, s, e in intervals:
+                ops[name] = ops.get(name, 0.0) + (e - s)
             coll_ops = obs_efficiency.collective_kind_times(ops)
+            overlap_rec = obs_efficiency.collective_overlap(intervals)
         except Exception:
             pass
         trace_rec = {"buckets": tsum.totals, "steps": len(tsum.steps),
                      "collective_ops": coll_ops}
+        if overlap_rec is not None:
+            trace_rec["overlap"] = overlap_rec
+            for ln in obs_efficiency.overlap_lines(overlap_rec):
+                print_fn(ln.strip())
         obs_writer.event("trace_buckets", **trace_rec)
     if hasattr(ds, "stats"):    # host decode-pool counters (real images)
         obs_writer.event("data", **ds.stats())

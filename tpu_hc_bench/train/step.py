@@ -6,13 +6,24 @@ buffer, 128 MiB) -> OpenMPI/HCOLL -> UCX -> IB verbs.  The TPU-native step
 compiles the whole thing into one XLA program: forward/backward on the MXU,
 gradient ``psum`` over the mesh's data axis (optionally through the
 Horovod-style fusion buckets of ``parallel.collectives``), optimizer update
-fused in.  Three variable-update modes mirror the reference's
+fused in.  Four variable-update modes extend the reference's
 ``--variable_update`` choices (flags.py):
 
 - ``psum`` (default; reference ``horovod``): ``jax.shard_map`` over the
   mesh — replicated params, sharded batch, explicit fused gradient psum.
+  ``--overlap_grad_comm=on`` (default) packs the fusion buckets in
+  backward-completion order so XLA's async collectives overlap the
+  remaining backward compute; ``off`` barriers the full gradient tree
+  first (the serialized control arm).
 - ``replicated``: GSPMD — params/batch get shardings, XLA inserts the
   collectives itself (the idiomatic-JAX arm of the A/B).
+- ``zero1``: ZeRO-1 optimizer-state sharding — gradients reduce-SCATTER
+  over the data axis (same fusion buckets, half the allreduce's ring
+  traffic), each device owns and updates 1/N of the optimizer state
+  (stacked ``[N, k]`` leaves sharded over the data axis), then the
+  updated parameter shards all-gather back to replicated params.  Same
+  Horovod per-worker-BN semantics as ``psum``; per-device optimizer
+  bytes drop ~1/N — the HBM lever for the big-param members.
 - fabric ``host`` (reference ``sock``): per-device grads are stacked to
   host, averaged in numpy, update applied on host — the slow-fallback
   smoke path.
@@ -37,7 +48,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_hc_bench.flags import BenchmarkConfig
 from tpu_hc_bench.models import ModelSpec
 from tpu_hc_bench.parallel.collectives import (
-    allreduce_gradients, fused_psum_tree,
+    all_gather_tree, allreduce_gradients, fused_psum_tree,
+    reduce_scatter_tree, zero1_shard_len,
 )
 from tpu_hc_bench.parallel import fabric as fabric_mod
 from tpu_hc_bench.resilience import guards
@@ -128,6 +140,102 @@ def abstract_train_state(
     )
 
 
+# ---------------------------------------------------------------------
+# ZeRO-1 state layout (--variable_update=zero1)
+#
+# Params stay replicated (the all-gather restores them every step); the
+# OPTIMIZER state is built over per-device parameter shards and sharded
+# over the data axis.  Layout: every param leaf of ``size`` elements owns
+# a shard of ``k = ceil(size / N)`` elements per device; the optimizer
+# state's array leaves are stacked ``[N, k]`` (row i = device i's shard)
+# and placed with ``P(DATA_AXIS)`` on the leading dim, scalar leaves
+# (e.g. adam's count) replicate.  The layout depends only on the param
+# shapes and N — NOT on the fusion threshold — so checkpoints survive
+# threshold changes; a zero1 checkpoint is NOT interchangeable with a
+# psum/replicated one (different opt-state shapes; Orbax fails loudly on
+# the structure mismatch).
+
+
+def _stack_param_shards(p: jax.Array, num_shards: int) -> jax.Array:
+    """``[N, k]`` stacked shards of a leaf (zero-padded to ``N * k``)."""
+    k = zero1_shard_len(p.size, num_shards)
+    flat = p.reshape(-1)
+    pad = num_shards * k - p.size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(num_shards, k)
+
+
+def _local_param_shard(p: jax.Array, idx, num_shards: int) -> jax.Array:
+    """Device ``idx``'s 1-D shard of a (replicated) param leaf — the
+    slice the sharded optimizer updates."""
+    k = zero1_shard_len(p.size, num_shards)
+    flat = p.reshape(-1)
+    pad = num_shards * k - p.size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return jax.lax.dynamic_slice(flat, (idx * k,), (k,))
+
+
+def make_zero1_state(model, cfg: BenchmarkConfig, example_batch: tuple,
+                     num_shards: int,
+                     rng: jax.Array | None = None) -> TrainState:
+    """TrainState for the zero1 arm: replicated params, optimizer state
+    built over stacked ``[N, k]`` param shards.
+
+    ``tx.init`` runs ON the stacked tree, which equals per-shard init
+    stacked for every registry optimizer (their inits are elementwise —
+    zeros_like traces/moments plus scalar counts).
+    """
+    base = make_train_state(model, cfg, example_batch, rng)
+    stacked = jax.tree.map(
+        lambda p: _stack_param_shards(p, num_shards), base.params)
+    return base.replace(opt_state=jax.jit(base.tx.init)(stacked))
+
+
+def zero1_opt_specs(opt_state, num_shards: int):
+    """PartitionSpec pytree for a zero1 optimizer state: stacked
+    ``[N, ...]`` array leaves shard over the data axis, scalars (step
+    counts, schedule state) replicate."""
+    return jax.tree.map(
+        lambda x: (P(DATA_AXIS)
+                   if getattr(x, "ndim", 0) >= 2
+                   and x.shape[0] == num_shards else P()),
+        opt_state)
+
+
+def place_zero1_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place a zero1 TrainState: everything replicated except the
+    optimizer state's stacked leaves, which shard over the data axis."""
+    num_shards = mesh.shape[DATA_AXIS]
+    repl = NamedSharding(mesh, P())
+    specs = zero1_opt_specs(state.opt_state, num_shards)
+    opt_state = jax.tree.map(
+        lambda s, x: jax.device_put(x, NamedSharding(mesh, s)),
+        specs, state.opt_state)
+    return state.replace(
+        step=jax.device_put(state.step, repl),
+        params=jax.device_put(state.params, repl),
+        batch_stats=jax.device_put(state.batch_stats, repl),
+        opt_state=opt_state,
+    )
+
+
+def _zero1_state_specs(state: TrainState, opt_specs) -> TrainState:
+    """A TrainState-shaped pytree of PartitionSpecs (shard_map
+    in/out_specs for the zero1 step): replicated everywhere except the
+    sharded optimizer leaves."""
+    repl = lambda tree: jax.tree.map(lambda _: P(), tree)
+    return TrainState(
+        step=P(),
+        params=repl(state.params),
+        batch_stats=repl(state.batch_stats),
+        opt_state=opt_specs,
+        apply_fn=state.apply_fn,
+        tx=state.tx,
+    )
+
+
 def prep_inputs(inputs):
     """uint8 wire format -> normalized float32, inside the compiled step.
 
@@ -211,6 +319,11 @@ def build_train_step(
     is_text = spec.is_text
     ctc = getattr(spec, "ctc", False)
     fuse = cfg.variable_update == "psum"
+    zero1 = cfg.variable_update == "zero1"
+    # --overlap_grad_comm: backward-order buckets (XLA async collectives
+    # overlap the remaining backward) vs a full-tree barrier (comm
+    # strictly after the complete backward — the A/B control)
+    overlap = getattr(cfg, "overlap_grad_comm", "on") == "on"
     guard = guards.guard_mode(cfg)      # --on_nonfinite: off|flag|skip
     from tpu_hc_bench.topology import DCN_AXIS, SEQ_AXIS as _SEQ
 
@@ -235,6 +348,23 @@ def build_train_step(
         raise ValueError(
             "--gradient_accumulation_steps is not supported on the host "
             "(sock-analog) fabric step")
+    if zero1:
+        # flags.resolve rejects the TP/EP/PP/SP compositions at flag
+        # time; these guards catch programmatic construction and the
+        # layouts only known here (fabric, multislice)
+        if fab is fabric_mod.Fabric.HOST:
+            raise ValueError(
+                "--variable_update=zero1 needs a device fabric (ici): "
+                "the host (sock-analog) path has no sharded optimizer")
+        if dcn:
+            raise ValueError(
+                "--variable_update=zero1 composes with single-slice data "
+                "parallelism only (the multislice (dcn, data) hierarchical "
+                "reduce has no reduce-scatter layout yet)")
+        if sp or tp or getattr(cfg, "expert_parallel", 1) > 1:
+            raise ValueError(
+                "--variable_update=zero1 composes with plain data "
+                "parallelism only")
     if fab is fabric_mod.Fabric.HOST:
         return _build_host_step(mesh, cfg, is_text, ctc=ctc)
     if not sp and (tp or getattr(cfg, "expert_parallel", 1) > 1):
@@ -359,6 +489,11 @@ def build_train_step(
             lambda x, o: (x / accum).astype(o.dtype), s, state.batch_stats)
         return l / accum, stats, grads
 
+    # zero1's shard_map specs depend on the optimizer-state STRUCTURE,
+    # known only when the first state arrives; the lazy step wrapper
+    # below fills this before device_step first traces
+    zero1_specs: dict = {}
+
     def device_step(state: TrainState, batch, dropout_rng):
         # per-device: local shard of the batch, replicated state
         for a in axes:
@@ -377,12 +512,25 @@ def build_train_step(
             (loss, new_stats), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(state.params)
-        grads = allreduce_gradients(
-            grads,
-            axis_name=axes,
-            threshold_bytes=cfg.fusion_threshold_bytes,
-            fuse=fuse,
-        )
+        if zero1:
+            # ZeRO-1: reduce-SCATTER the gradient buckets (each device
+            # receives only its 1/N shard of the mean grads), update the
+            # local optimizer-state + param shards, all-gather the
+            # updated param shards back to replicated params
+            num_shards = jax.lax.axis_size(DATA_AXIS)
+            idx = jax.lax.axis_index(DATA_AXIS)
+            grad_shards = reduce_scatter_tree(
+                grads, axis_name=DATA_AXIS,
+                threshold_bytes=cfg.fusion_threshold_bytes,
+                average=True, overlap=overlap)
+        else:
+            grads = allreduce_gradients(
+                grads,
+                axis_name=axes,
+                threshold_bytes=cfg.fusion_threshold_bytes,
+                fuse=fuse,
+                overlap=overlap,
+            )
         loss = jax.lax.pmean(loss, axes)
         if new_stats:
             # sync running stats so replicated state stays identical —
@@ -390,7 +538,7 @@ def build_train_step(
             # the world=2 HLO count showed resnet20's 44 collectives vs
             # bert's 2 were per-tensor BN-stat pmeans; bucketing them
             # turns 42 latency-bound crossings into one)
-            if fuse:
+            if fuse or zero1:
                 new_stats = fused_psum_tree(
                     new_stats, axis_name=axes,
                     threshold_bytes=cfg.fusion_threshold_bytes,
@@ -399,8 +547,32 @@ def build_train_step(
                 new_stats = jax.tree.map(
                     lambda s: jax.lax.pmean(s, axes), new_stats
                 )
-        updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero1:
+            opt_specs = zero1_specs["opt"]
+            param_shards = jax.tree.map(
+                lambda p: _local_param_shard(p, idx, num_shards),
+                state.params)
+            # the local view of a [N, k] P(data)-sharded opt leaf is
+            # [1, k]: drop the shard dim for the update, restore it for
+            # the out_specs
+            local_opt = jax.tree.map(
+                lambda s, x: x.reshape(x.shape[1:])
+                if s == P(DATA_AXIS) else x,
+                opt_specs, state.opt_state)
+            updates, new_local_opt = state.tx.update(
+                grad_shards, local_opt, param_shards)
+            new_shards = optax.apply_updates(param_shards, updates)
+            new_params = all_gather_tree(
+                new_shards, state.params, axis_name=DATA_AXIS,
+                threshold_bytes=cfg.fusion_threshold_bytes,
+                overlap=overlap)
+            new_opt = jax.tree.map(
+                lambda s, x: x[None] if s == P(DATA_AXIS) else x,
+                opt_specs, new_local_opt)
+        else:
+            updates, new_opt = state.tx.update(grads, state.opt_state,
+                                               state.params)
+            new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -413,7 +585,18 @@ def build_train_step(
             # with a select INSIDE this compiled program — the only
             # donation-safe spelling, since the input state's buffers are
             # donated to this step (resilience/guards.py)
-            ok = guards.finite_flag(loss, grads)
+            if zero1:
+                # each device sees only its grad shards; the flag must
+                # agree across devices or the skip-select would fork the
+                # replicated state — sum the squared norm over the axis
+                # (= global_norm**2 of the full mean-gradient tree)
+                gsq = sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grad_shards))
+                gsq = jax.lax.psum(gsq, DATA_AXIS)
+                ok = guards.finite_flag(loss) & jnp.isfinite(gsq)
+            else:
+                ok = guards.finite_flag(loss, grads)
             if guard == "skip":
                 new_state = guards.select_state(ok, new_state, state)
             return new_state, {"loss": loss,
@@ -437,6 +620,35 @@ def build_train_step(
     # dcn+data both split the leading batch dim (one tuple group); the SP
     # pair splits batch dim 0 (data) and seq dim 1 separately
     sharded = P((DCN_AXIS, DATA_AXIS)) if dcn else P(*axes)
+    if zero1:
+        # the in/out specs must name each sharded optimizer leaf, and the
+        # optimizer-state STRUCTURE is only known from a live state — so
+        # the shard_map is built lazily on the first call and cached (the
+        # structure is fixed for the run; a second structure would be a
+        # driver bug and jit would reject it anyway)
+        cell: dict = {}
+
+        def step(state, batch, rng):
+            fn = cell.get("fn")
+            if fn is None:
+                num_shards = mesh.shape[DATA_AXIS]
+                zero1_specs["opt"] = zero1_opt_specs(state.opt_state,
+                                                     num_shards)
+                state_specs = _zero1_state_specs(state, zero1_specs["opt"])
+                shard_fn = jax.shard_map(
+                    device_step,
+                    mesh=mesh,
+                    in_specs=(state_specs, sharded, replicated),
+                    out_specs=(state_specs, replicated),
+                    check_vma=False,
+                )
+                fn = jax.jit(shard_fn, donate_argnums=(0,))
+                cell["fn"] = fn
+                # obs.efficiency AOT-lowers this handle (see below)
+                step._jitted = fn
+            return fn(state, batch, rng)
+
+        return step
     manual: dict = {}
     if sp and tp:
         # partial-manual shard_map: data/seq manual, model auto (GSPMD)

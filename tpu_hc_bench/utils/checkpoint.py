@@ -19,6 +19,20 @@ newest *complete* step automatically.  ``gc_checkpoints`` is the
 ``--keep_checkpoints=N`` retention pass (newest N complete steps
 survive; stale ``.tmp``/sentinel-less debris is reaped).
 
+Sharded optimizer state (``--variable_update=zero1``, round 6): the
+opt-state leaves are stacked ``[N, k]`` arrays sharded over the data
+axis.  Single-process saves go through the normal host path — the
+``device_get`` in ``snapshot_to_host`` GATHERS the shards (gather-on-
+save, manifest-noted by the driver), so the on-disk layout is the
+plain stacked array and ``restore`` into a ``make_zero1_state``
+template + ``place_zero1_state`` round-trips bitwise.  Multi-host
+zero1 states are NOT host-addressable and take the ``sharded=True``
+Orbax path (restore after placement), exactly like the TP/EP states.
+The layout depends only on param shapes and N — not on the fusion
+threshold — but a zero1 checkpoint is not interchangeable with a
+psum/replicated one (different opt-state tree; the structure mismatch
+fails loudly at restore).
+
 Async saves (round 10): a synchronous ``save`` blocks the step loop
 for snapshot + Orbax write + fsync + commit, but only the *snapshot*
 actually needs the step loop stopped — the write targets host memory
