@@ -62,6 +62,12 @@ def main() -> int:
         fusion_threshold_bytes=int(os.environ.get(
             "BENCH_FUSION_THRESHOLD", "134217728")),
         overlap_grad_comm=os.environ.get("BENCH_OVERLAP", "on"),
+        # round 12: elastic-resume knobs — BENCH_TRAIN_DIR checkpoints
+        # the bench run (topology sidecar included), BENCH_RESUME=elastic
+        # continues a prior bench run on a different world size; the
+        # resume identity rides the JSON `extra` either way
+        train_dir=os.environ.get("BENCH_TRAIN_DIR") or None,
+        resume=os.environ.get("BENCH_RESUME", "auto"),
     ).resolve()
 
     # human-readable progress to stderr; stdout carries only the JSON line
@@ -107,6 +113,11 @@ def main() -> int:
             "goodput": (round(result.goodput, 4)
                         if result.goodput == result.goodput else None),
             "goodput_phases": result.goodput_phases,
+            # resume topology (saved world -> live world, arm): a
+            # post-resume throughput shift with a world-size change is
+            # a different experiment — obs diff and the BENCH history
+            # must both see it as config drift, not a regression
+            "resume": result.resume,
         },
         "manifest": {
             k: manifest.get(k)

@@ -94,11 +94,11 @@ def test_async_writer_error_surfaces_at_barrier(tmp_path, monkeypatch):
     w = ckpt.AsyncCheckpointWriter(tmp_path)
     boom = [1] * retry_mod.DEFAULT_ATTEMPTS    # every attempt fails
 
-    def failing(payload, directory, step):
+    def failing(payload, directory, step, topology=None):
         if boom:
             boom.pop()
             raise OSError("disk full")
-        return real(payload, directory, step)
+        return real(payload, directory, step, topology=topology)
 
     real = ckpt.write_host_payload
     monkeypatch.setattr(ckpt, "write_host_payload", failing)

@@ -23,6 +23,13 @@ shipped) are checked statically:
   ``donate_argnums`` position of a jitted call and then read again
   later in the same scope — donation invalidates it, and XLA's runtime
   error surfaces far from the offending read.
+- **checkpoint-topology** (warning): a checkpoint-writing call site
+  (``ckpt.save``/``save_pp``/``write_host_payload``/an async writer's
+  ``submit``) that does not pass a ``topology=`` sidecar record.  The
+  elastic-resume path (round 12) can only re-place a checkpoint whose
+  save recorded the world/mesh/arm it was written under; a save path
+  added without the sidecar silently produces checkpoints that resume
+  on the identical mesh only.
 - **sharding-consistency** (warning): per model, the Megatron
   annotation table (``train.step.tp_param_spec``) is replayed against
   the abstractly-initialized param tree: a rule whose *name* matches a
@@ -55,7 +62,8 @@ RECOMPILE = "recompile-hazard"
 DONATION = "donated-buffer-misuse"
 SHARDING = "sharding-consistency"
 COLLECTIVE_SHAPE = "collective-shape"
-ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION)
+CKPT_TOPOLOGY = "checkpoint-topology"
+ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -442,6 +450,41 @@ class _FileLinter:
             out.add(stmt.target.id)
         return out
 
+    # -- pass: checkpoint writes without a topology sidecar ------------
+
+    # module aliases under which this repo's checkpoint API is called
+    # (`ckptr`, the orbax PyTreeCheckpointer convention, deliberately
+    # does NOT match: its .save is the raw writer the protocol wraps)
+    _CKPT_MODULE_ALIASES = {"ckpt", "ckpt_mod", "checkpoint"}
+
+    def _check_checkpoint_topology(self):
+        """Checkpoint-writing call sites must pass ``topology=``: the
+        elastic-resume sidecar is only as complete as the save paths
+        that record it, and a new call site that forgets it produces
+        checkpoints that resume on the identical mesh only."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            base = name.rsplit(".", 1)[-1]
+            prefix = name.rsplit(".", 2)[-2] if "." in name else ""
+            hit = (base in ("save_pp", "write_host_payload")
+                   or (base == "save"
+                       and prefix in self._CKPT_MODULE_ALIASES)
+                   or (base == "submit" and "ckpt" in prefix.lower()))
+            if not hit:
+                continue
+            if any(kw.arg == "topology" for kw in node.keywords):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue    # **kwargs splat: can't see inside
+            self._emit(
+                CKPT_TOPOLOGY, "warning", node,
+                f"checkpoint write `{name}(...)` without a `topology=` "
+                "sidecar record — the checkpoint will refuse/skip "
+                "elastic resume; pass topology.topology_record(...) "
+                "(or None deliberately, with a thb:lint-ok note)")
+
     # -- driver --------------------------------------------------------
 
     def run(self) -> list[Finding]:
@@ -449,6 +492,7 @@ class _FileLinter:
             self._check_host_sync(ctx)
             self._check_recompile(ctx)
         self._check_donation()
+        self._check_checkpoint_topology()
         return self.findings
 
 

@@ -332,6 +332,17 @@ class BenchmarkConfig:
                                               # (error if none — crash-loop
                                               # relaunches shouldn't
                                               # silently restart from step 0)
+                                              # | elastic (must + the saved
+                                              # topology sidecar may differ
+                                              # from the live mesh: the
+                                              # state is reassembled and
+                                              # re-placed — zero1 opt
+                                              # shards resplit to the new
+                                              # world size — with a loud
+                                              # one-line plan; genuinely
+                                              # incompatible arm/layout
+                                              # transitions refuse with an
+                                              # actionable error)
     step_timeout_s: str | None = None         # hung-step watchdog: seconds,
                                               # "auto" (k x warmup mean step
                                               # time), unset/off = disabled
@@ -565,11 +576,11 @@ class BenchmarkConfig:
         if self.max_bad_steps < 1:
             raise ValueError(
                 f"--max_bad_steps must be >= 1: {self.max_bad_steps}")
-        if self.resume not in ("auto", "never", "must"):
+        if self.resume not in ("auto", "never", "must", "elastic"):
             raise ValueError(
-                f"--resume must be auto|never|must: {self.resume!r}")
-        if self.resume == "must" and not self.train_dir:
-            raise ValueError("--resume=must needs --train_dir")
+                f"--resume must be auto|never|must|elastic: {self.resume!r}")
+        if self.resume in ("must", "elastic") and not self.train_dir:
+            raise ValueError(f"--resume={self.resume} needs --train_dir")
         if self.keep_checkpoints < 0:
             raise ValueError(
                 f"--keep_checkpoints must be >= 0: {self.keep_checkpoints}")
@@ -759,7 +770,7 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["abort", "skip", "rewind"])
     p.add_argument("--max_bad_steps", type=int, default=d.max_bad_steps)
     p.add_argument("--resume", type=str, default=d.resume,
-                   choices=["auto", "never", "must"])
+                   choices=["auto", "never", "must", "elastic"])
     p.add_argument("--step_timeout_s", type=str, default=d.step_timeout_s)
     p.add_argument("--keep_checkpoints", type=int,
                    default=d.keep_checkpoints)

@@ -416,6 +416,15 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
                  for v in mem["devices"].values()]
         lines.append(f"  memory: peak {max(peaks) / 2**20:.1f} MiB/device "
                      f"({len(peaks)} device(s))")
+    resume = _last(records, "resume")
+    if resume:
+        # elastic-resume identity: a post-resume throughput shift with a
+        # world-size change is a different experiment, not a regression
+        lines.append(
+            f"  resume: step {resume.get('restored_step')}  world "
+            f"{resume.get('saved_world')}->{resume.get('live_world')}  "
+            f"arm={resume.get('arm')}"
+            + (" (elastic reshard)" if resume.get("elastic") else ""))
     res = [r for r in records if r.get("kind") in RESILIENCE_KINDS]
     if res:
         counts: dict[str, int] = {}

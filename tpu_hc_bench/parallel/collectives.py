@@ -227,6 +227,27 @@ def zero1_shard_len(size: int, num_shards: int) -> int:
     return -(-size // num_shards)
 
 
+def zero1_resplit_rows(rows, size: int, num_shards: int):
+    """Re-layout one leaf's stacked shards for a NEW axis size: the
+    elastic-resume reshard (``--resume=elastic``).
+
+    ``rows`` is the gathered ``[n_old, k_old]`` stacked-shard array of a
+    ``size``-element leaf (``_leaf_to_rows``' layout: flattened leaf,
+    zero-padded to ``n_old * k_old``).  Strip the old padding, re-pad to
+    ``num_shards * zero1_shard_len(size, num_shards)``, restack — pure
+    host numpy, bitwise on the ``size`` real elements, so an 8-way
+    checkpoint resplit to 4 and back to 8 round-trips exactly.
+    """
+    import numpy as np
+
+    k = zero1_shard_len(size, num_shards)
+    flat = np.asarray(rows).reshape(-1)[:size]
+    pad = num_shards * k - size
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    return flat.reshape(num_shards, k)
+
+
 def _leaf_to_rows(leaf: jax.Array, num_shards: int, wire_dtype) -> jax.Array:
     """Pad a leaf to ``num_shards * k`` and reshape ``[num_shards, k]`` —
     row ``i`` is device ``i``'s shard of the flattened leaf."""

@@ -32,16 +32,31 @@ class PreemptedError(RuntimeError):
     """The run stopped at a step boundary to honor a preemption signal.
 
     ``launcher.main`` maps this to ``resilience.EXIT_PREEMPTED`` (75).
+
+    ``topology`` is the run's checkpoint-topology record
+    (``topology.topology_record``) — the emergency checkpoint carries
+    the same record as its sidecar, so the message can tell the
+    relauncher which world wrote it and that ``--resume=elastic``
+    continues it on a DIFFERENT world size (the preempted fleet may
+    not come back at full strength).
     """
 
     def __init__(self, step: int, checkpoint_saved: bool,
-                 signum: int | None = None):
+                 signum: int | None = None,
+                 topology: dict | None = None):
         self.step = step
         self.checkpoint_saved = checkpoint_saved
         self.signum = signum
-        ckpt = ("emergency checkpoint saved; relaunch with --resume=auto "
-                "to continue" if checkpoint_saved
-                else "no --train_dir, nothing saved")
+        self.topology = topology
+        if checkpoint_saved:
+            world = (topology or {}).get("world")
+            saved_as = f" (world {world})" if world else ""
+            ckpt = (f"emergency checkpoint saved{saved_as}; relaunch "
+                    f"with --resume=auto to continue — or "
+                    f"--resume=elastic to continue on a different "
+                    f"world size")
+        else:
+            ckpt = "no --train_dir, nothing saved"
         super().__init__(
             f"preempted after timed step {step} "
             f"(signal {signum}): {ckpt}")

@@ -145,6 +145,130 @@ def select_devices(
     return picked
 
 
+# ---------------------------------------------------------------------
+# Checkpoint topology descriptors (elastic resume, round 12)
+#
+# A production preemptible fleet does not restart on the mesh it died
+# on: nodes are re-imaged and re-assembled, and the benchmark must
+# resume on whatever is alive (the reference's cluster-self-assembly
+# premise, PAPER.md).  Every checkpoint therefore records a small
+# *topology sidecar* — the layout facts restore needs to decide whether
+# the saved state drops straight onto the live mesh, needs a reshard
+# (zero1's [N, k] optimizer shards), or is genuinely incompatible.
+# ``topology_record`` builds it, ``elastic_plan`` is the one home of the
+# compatibility policy, and ``utils.checkpoint``/``train.driver``
+# enforce it (--resume=elastic).
+
+# what on-disk form the checkpoint took: "host" = host-gathered full
+# arrays (replicated DP/SP/TP single-process, zero1 gather-on-save),
+# "sharded" = multi-host per-shard Orbax jax.Array I/O, "pp-native" =
+# the stacked [L, ...] pipeline trunk layout of save_pp
+CKPT_LAYOUTS = ("host", "sharded", "pp-native")
+# arms whose on-disk state tree is identical (replicated params + a
+# param-shaped optimizer state): transitions inside this set are free
+REPLICATED_ARMS = ("psum", "replicated")
+
+
+def topology_record(layout: Layout, mesh: Mesh, cfg,
+                    layout_kind: str = "host") -> dict:
+    """The checkpoint topology sidecar: everything ``restore`` must know
+    about the world that wrote a checkpoint to re-place it elsewhere."""
+    if layout_kind not in CKPT_LAYOUTS:
+        raise ValueError(f"layout_kind must be one of {CKPT_LAYOUTS}: "
+                         f"{layout_kind!r}")
+    return {
+        "schema": 1,
+        "world": int(layout.total_workers),
+        "process_count": int(jax.process_count()),
+        "mesh": {str(k): int(v) for k, v in dict(mesh.shape).items()},
+        "variable_update": cfg.variable_update,
+        "pipeline_parallel": int(getattr(cfg, "pipeline_parallel", 1) or 1),
+        "layout": layout_kind,
+        "dtype": cfg.compute_dtype,
+    }
+
+
+def _mesh_str(rec: dict | None) -> str:
+    """Render a record's mesh dict as ``data:8xmodel:1`` (``?`` when
+    absent) — the ONE home of the rendering, shared by the mismatch
+    error and the elastic plan line."""
+    mesh = "x".join(f"{k}:{v}"
+                    for k, v in ((rec or {}).get("mesh") or {}).items())
+    return mesh or "?"
+
+
+def describe_topology(rec: dict | None) -> str:
+    """One-line human rendering of a topology record (mismatch errors
+    and the elastic-resume plan line both use it)."""
+    if not rec:
+        return "unknown (no topology sidecar)"
+    return (f"world={rec.get('world')} mesh=[{_mesh_str(rec)}] "
+            f"arm={rec.get('variable_update')} "
+            f"pp={rec.get('pipeline_parallel', 1)} "
+            f"layout={rec.get('layout')} dtype={rec.get('dtype')}")
+
+
+def elastic_plan(saved: dict, live: dict) -> tuple[str, str]:
+    """Compare a checkpoint's recorded topology against the live one.
+
+    Returns ``(action, line)``:
+
+    - ``("ok", "")`` — identical topology; restore as always.
+    - ``("noop", plan)`` — topologies differ but the on-disk form is
+      layout-neutral (host-layout replicated trees restore onto any
+      mesh; pp-native stacked global shapes are pipe-degree independent
+      and Orbax re-places them).  ``plan`` is the one-line note the
+      driver prints.
+    - ``("reshard", plan)`` — restorable, but only through the elastic
+      path (``--resume=elastic``): zero1's gathered ``[N, k]`` optimizer
+      shards must be resplit to the new world size.
+    - ``("refuse", reason)`` — genuinely incompatible: the state trees
+      differ (zero1 vs replicated optimizer, pp-native vs DP layout) or
+      the shards are not reassemblable here (multi-host model-sharded
+      saves).
+    """
+    same = all(
+        saved.get(k) == live.get(k)
+        for k in ("world", "mesh", "variable_update", "pipeline_parallel",
+                  "layout"))
+    if same:
+        return "ok", ""
+    s_arm = saved.get("variable_update")
+    l_arm = live.get("variable_update")
+    s_lay = saved.get("layout", "host")
+    l_lay = live.get("layout", "host")
+    sw, lw = saved.get("world"), live.get("world")
+    if (s_arm == "zero1") != (l_arm == "zero1"):
+        return ("refuse",
+                f"arm {s_arm}->{l_arm}: the zero1 optimizer-state tree "
+                f"(stacked [N, k] shards) and the replicated one are "
+                f"different structures — resume on --variable_update="
+                f"{s_arm}, or restart fresh")
+    if ("pp-native" in (s_lay, l_lay)) and s_lay != l_lay:
+        return ("refuse",
+                f"layout {s_lay}->{l_lay}: pp-native stacked-trunk "
+                f"checkpoints and DP-layout ones are different trees — "
+                f"resume under the saved layout")
+    if "sharded" in (s_lay, l_lay):
+        return ("refuse",
+                f"layout {s_lay}->{l_lay} with world {sw}->{lw}: "
+                f"multi-host model-sharded checkpoints resume on the "
+                f"saved topology only (per-shard Orbax I/O is not "
+                f"host-reassemblable here)")
+    extra = ("" if saved.get("dtype") == live.get("dtype")
+             else f"; note: dtype policy {saved.get('dtype')}->"
+                  f"{live.get('dtype')} (params restore bitwise, compute "
+                  f"dtype changes)")
+    if s_arm == "zero1":        # and l_arm == "zero1"
+        return ("reshard",
+                f"zero1 optimizer shards resplit [{sw}, k]->[{lw}, k'] "
+                f"over the data axis (world {sw}->{lw}){extra}")
+    return ("noop",
+            f"replicated {s_arm} state re-placed onto the live mesh "
+            f"(world {sw}->{lw}, mesh [{_mesh_str(saved)}]->"
+            f"[{_mesh_str(live)}]){extra}")
+
+
 def build_mesh(
     layout: Layout,
     devices: Sequence[jax.Device] | None = None,

@@ -35,6 +35,7 @@ from tpu_hc_bench.resilience import (
 from tpu_hc_bench.resilience.retry import retry_io
 from tpu_hc_bench.topology import (
     DATA_AXIS, Layout, SEQ_AXIS, build_mesh, discover_layout,
+    topology_record,
 )
 from tpu_hc_bench.train import step as step_mod
 from tpu_hc_bench.utils import hw
@@ -72,6 +73,11 @@ class BenchmarkResult:
     # compiled.cost_analysis() of the actual step program, "analytic" =
     # the hand-maintained spec.flops_per_example table (obs.efficiency)
     mfu_source: str = "analytic"
+    # resume identity when this run restored a checkpoint (None for a
+    # fresh run): restored_step, saved_world -> live_world, arm, and
+    # whether the elastic reshard ran — so `obs diff`/BENCH json can
+    # attribute a post-resume throughput shift to the topology change
+    resume: dict | None = None
 
     def json_line(self) -> dict:
         return dataclasses.asdict(self)
@@ -486,20 +492,29 @@ def _fingerprint_line(params, print_fn) -> None:
         pass
 
 
-def _maybe_restore(state, cfg, print_fn, sharded=False):
+def _maybe_restore(state, cfg, print_fn, sharded=False, topo=None,
+                   obs_writer=None):
     """--train_dir resume: restore the latest COMPLETE checkpoint, per
     the ``--resume`` policy (auto = restore if one exists, never = fresh
-    init, must = error when none — a crash-looping relaunch must not
-    silently restart from step 0).
+    init, must/elastic = error when none — a crash-looping relaunch must
+    not silently restart from step 0).
 
-    Returns ``(state, restored?)``.  Default mode restores host arrays
-    (the caller re-places them on the mesh); ``sharded=True`` takes an
-    already-PLACED template and restores each array with its committed
-    sharding, every process reading only its addressable shards (the
-    multi-host model-sharded path).
+    Returns ``(state, restored?, resume_record)``.  Default mode
+    restores host arrays (the caller re-places them on the mesh);
+    ``sharded=True`` takes an already-PLACED template and restores each
+    array with its committed sharding, every process reading only its
+    addressable shards (the multi-host model-sharded path).
+
+    ``topo``: the LIVE topology record.  A checkpoint whose sidecar
+    disagrees is validated through ``topology.elastic_plan`` — a loud
+    :class:`utils.checkpoint.TopologyMismatchError` replaces the old
+    opaque Orbax sharding error, ``--resume=elastic`` routes zero1
+    states through the resplit path, and a one-line plan of what is
+    being reshaped is printed.  The resume record (restored step, saved
+    vs live world, arm) is also emitted into the metrics stream.
     """
     if not cfg.train_dir or cfg.resume == "never":
-        return state, False
+        return state, False, None
     from pathlib import Path
 
     from tpu_hc_bench.utils import checkpoint as ckpt
@@ -518,24 +533,57 @@ def _maybe_restore(state, cfg, print_fn, sharded=False):
                 f"or pre-sentinel checkpoints — verify and `touch "
                 f"<dir>/step_NNNNNNNN.complete` to adopt; starting "
                 f"fresh")
-        if cfg.resume == "must":
+        if cfg.resume in ("must", "elastic"):
             raise FileNotFoundError(
-                f"--resume=must: no complete checkpoint under "
+                f"--resume={cfg.resume}: no complete checkpoint under "
                 f"{cfg.train_dir}")
-        return state, False
-    state = ckpt.restore(state, cfg.train_dir, sharded=sharded)
-    print_fn(f"restored checkpoint step "
-             f"{int(jax.device_get(state.step))} from {cfg.train_dir}")
+        return state, False, None
+    saved_topo = ckpt.read_topology(cfg.train_dir)
+    action, plan = "ok", ""
+    if topo is not None and saved_topo is not None:
+        # one loud line + error instead of an opaque Orbax shape error:
+        # check_topology raises unless the transition is a no-op or an
+        # elastic reshard the operator asked for
+        action, plan = ckpt.check_topology(
+            saved_topo, topo, cfg.train_dir,
+            elastic=cfg.resume == "elastic")
+        if plan:
+            print_fn(f"elastic resume: {plan}")
+    elif cfg.resume == "elastic" and saved_topo is None:
+        print_fn("elastic resume: checkpoint has no topology sidecar "
+                 "(pre-elastic save); assuming the saved topology "
+                 "matches the live one")
+    if action == "reshard" and not sharded:
+        state = ckpt.restore_elastic(state, cfg.train_dir, saved_topo,
+                                     topo["world"])
+    else:
+        state = ckpt.restore(state, cfg.train_dir, sharded=sharded)
+    restored_step = int(jax.device_get(state.step))
+    print_fn(f"restored checkpoint step {restored_step} from "
+             f"{cfg.train_dir}")
     if not sharded:
         _fingerprint_line(state.params, print_fn)
-    return state, True
+    rec = None
+    if saved_topo is not None or topo is not None:
+        rec = {"restored_step": restored_step,
+               "saved_world": (saved_topo or {}).get("world"),
+               "live_world": (topo or {}).get("world"),
+               "arm": (saved_topo or {}).get("variable_update"),
+               "elastic": action == "reshard"}
+        if obs_writer is not None:
+            obs_writer.event("resume", **rec, saved_topology=saved_topo,
+                             live_topology=topo, plan=plan or None)
+    return state, True, rec
 
 
-def _save_state(state, cfg, print_fn, pp_ctx=None, sharded=False):
+def _save_state(state, cfg, print_fn, pp_ctx=None, sharded=False,
+                topology=None):
     """Save to --train_dir.  ``state`` is a TrainState, or the PP
     ``(params, opt_state)`` tuple when ``pp_ctx=(model, template)`` — the
     DP<->DPxPP checkpoint interchange: PP runs restack into the DP layout
-    so the checkpoint restores under either strategy.
+    so the checkpoint restores under either strategy.  ``topology`` is
+    the run's sidecar record (``topology.topology_record``), committed
+    next to the step sentinel for elastic resume.
 
     Multi-process: ALL processes call (Orbax synchronizes internally and
     the primary host writes the replicated arrays); the driver guard has
@@ -553,7 +601,8 @@ def _save_state(state, cfg, print_fn, pp_ctx=None, sharded=False):
             params, opt_state, template, model.num_layers)
         state = state.replace(
             step=jax.numpy.asarray(steps_done, jax.numpy.int32))
-    path = ckpt.save(state, cfg.train_dir, sharded=sharded)
+    path = ckpt.save(state, cfg.train_dir, sharded=sharded,
+                     topology=topology)
     print_fn(f"checkpoint saved: {path}")
 
 
@@ -780,6 +829,16 @@ def run_benchmark(
     # at fixed per-worker batch) shrinks by the minor-axis product
     global_batch = layout.global_batch(cfg.batch_size) // mp
 
+    # elastic-resume topology record (round 12): world/mesh/arm/layout/
+    # dtype identity, written as a sidecar next to every checkpoint's
+    # commit sentinel and validated at restore — the thing that lets a
+    # preempted 8-way run continue on the 4 chips that survive
+    topo_rec = topology_record(
+        layout=layout, mesh=mesh, cfg=cfg,
+        layout_kind=("pp-native" if pp_native_ckpt
+                     else "sharded" if sharded_ckpt else "host"))
+    resume_rec: dict | None = None
+
     dtype = model_dtype or jnp.dtype(cfg.compute_dtype)
     model, spec = create_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, attention_impl=cfg.attention_impl,
@@ -887,7 +946,9 @@ def run_benchmark(
     # records are already globally aggregated (psum'd loss, global-batch
     # rates), so its view is the merged record
     if cfg.metrics_dir and jax.process_index() == 0:
-        manifest_extra: dict = {}
+        # checkpoint topology identity rides the manifest too, so `obs
+        # diff` can name a world-size change across a resume boundary
+        manifest_extra: dict = {"topology": topo_rec}
         if compile_cache_dir:
             manifest_extra["compile_cache"] = {
                 "dir": compile_cache_dir,
@@ -1094,7 +1155,8 @@ def run_benchmark(
         state = step_mod.make_train_state(init_model, cfg, batch)
         state = state.replace(apply_fn=model.apply)
         if not sharded_ckpt:
-            state, sp_restored = _maybe_restore(state, cfg, print_fn)
+            state, sp_restored, resume_rec = _maybe_restore(
+                state, cfg, print_fn, topo=topo_rec, obs_writer=obs_writer)
         if tp > 1:
             # DP x SP x TP: params/opt model-sharded (auto axis), the SP
             # step's shard_map stays manual over data+seq only
@@ -1107,8 +1169,9 @@ def run_benchmark(
             # multi-host SP x TP (round 4): same restore-after-placement
             # as the plain TP arm — Orbax reads each array straight into
             # its committed model sharding
-            state, sp_restored = _maybe_restore(state, cfg, print_fn,
-                                                sharded=True)
+            state, sp_restored, resume_rec = _maybe_restore(
+                state, cfg, print_fn, sharded=True, topo=topo_rec,
+                obs_writer=obs_writer)
         batch_iter = batches()
         if cfg.eval:
             # round 3: SP eval — the (data, seq) shard_map eval arm with
@@ -1161,13 +1224,25 @@ def run_benchmark(
 
             params, opt_state = pipe_mod.make_pp_state(model, cfg, batch[0],
                                                        mesh, tp=tp > 1)
-            if (cfg.resume == "must"
+            if (cfg.resume in ("must", "elastic")
                     and ckpt_mod.latest_step(cfg.train_dir) is None):
                 raise FileNotFoundError(
-                    f"--resume=must: no complete checkpoint under "
-                    f"{cfg.train_dir}")
+                    f"--resume={cfg.resume}: no complete checkpoint "
+                    f"under {cfg.train_dir}")
             if (cfg.resume != "never"
                     and ckpt_mod.latest_step(cfg.train_dir) is not None):
+                saved_topo = ckpt_mod.read_topology(cfg.train_dir)
+                if saved_topo is not None:
+                    # pp-native stacked global shapes are pipe-degree
+                    # independent and Orbax re-places them, so same-
+                    # layout mesh changes validate as a no-op; cross-
+                    # layout transitions refuse loudly here instead of
+                    # dying in an Orbax structure mismatch
+                    _, plan = ckpt_mod.check_topology(
+                        saved_topo, topo_rec, cfg.train_dir,
+                        elastic=cfg.resume == "elastic")
+                    if plan:
+                        print_fn(f"elastic resume: {plan}")
                 if cfg.eval:
                     params, _, pp_base_step = ckpt_mod.restore_pp(
                         params, None, cfg.train_dir)
@@ -1178,6 +1253,16 @@ def run_benchmark(
                 restored = True
                 print_fn(f"restored checkpoint step {pp_base_step} from "
                          f"{cfg.train_dir} (PP-native)")
+                if saved_topo is not None:
+                    resume_rec = {
+                        "restored_step": pp_base_step,
+                        "saved_world": saved_topo.get("world"),
+                        "live_world": topo_rec.get("world"),
+                        "arm": saved_topo.get("variable_update"),
+                        "elastic": False}
+                    obs_writer.event("resume", **resume_rec,
+                                     saved_topology=saved_topo,
+                                     live_topology=topo_rec, plan=None)
             if cfg.eval:
                 _require_checkpoint_for_eval(cfg, restored, print_fn)
         else:
@@ -1189,8 +1274,9 @@ def run_benchmark(
                 # re-place
                 pp_template = step_mod.abstract_train_state(model, cfg,
                                                             batch)
-                restored_t, restored = _maybe_restore(pp_template, cfg,
-                                                      print_fn)
+                restored_t, restored, resume_rec = _maybe_restore(
+                    pp_template, cfg, print_fn, topo=topo_rec,
+                    obs_writer=obs_writer)
                 if restored:
                     pp_base_step = int(np.asarray(restored_t.step))
                     if cfg.eval:
@@ -1256,7 +1342,8 @@ def run_benchmark(
         else:
             state = step_mod.make_train_state(model, cfg, batch)
         if not sharded_ckpt:
-            state, restored = _maybe_restore(state, cfg, print_fn)
+            state, restored, resume_rec = _maybe_restore(
+                state, cfg, print_fn, topo=topo_rec, obs_writer=obs_writer)
         if mp > 1:
             mode = "ep" if getattr(cfg, "expert_parallel", 1) > 1 else "tp"
             place_fn = lambda s, m=mode: step_mod.shard_state_tp(s, mesh, m)
@@ -1268,8 +1355,9 @@ def run_benchmark(
         if sharded_ckpt:
             # multi-host TP/EP: restore AFTER placement so Orbax reads
             # each array straight into its committed sharding
-            state, restored = _maybe_restore(state, cfg, print_fn,
-                                             sharded=True)
+            state, restored, resume_rec = _maybe_restore(
+                state, cfg, print_fn, sharded=True, topo=topo_rec,
+                obs_writer=obs_writer)
         if cfg.eval:
             _require_checkpoint_for_eval(cfg, restored, print_fn)
         batch_iter = batches()
@@ -1442,7 +1530,8 @@ def run_benchmark(
             phases.enter("checkpoint_async", step=i)
             t_snap = time.monotonic()
             try:
-                async_ckpt.submit(state, gc_keep=cfg.keep_checkpoints)
+                async_ckpt.submit(state, gc_keep=cfg.keep_checkpoints,
+                                  topology=topo_rec)
                 print_fn(f"checkpoint snapshot: step {i} "
                          f"({time.monotonic() - t_snap:.3f}s blocking; "
                          f"write overlapped)")
@@ -1459,7 +1548,8 @@ def run_benchmark(
 
                 p, o = state
                 path = ckpt_mod.save_pp(
-                    p, o, pp_base_step + warmup_steps + i, cfg.train_dir)
+                    p, o, pp_base_step + warmup_steps + i, cfg.train_dir,
+                    topology=topo_rec)
                 print_fn(f"checkpoint saved: {path} (PP-native)")
                 return
             ctx = None
@@ -1470,7 +1560,7 @@ def run_benchmark(
                 # lower step
                 ctx = (pp_model, pp_template, pp_base + warmup_steps + i)
             _save_state(state, cfg, print_fn, pp_ctx=ctx,
-                        sharded=sharded_ckpt)
+                        sharded=sharded_ckpt, topology=topo_rec)
 
         # a multi-GB save to slow storage stalls the step loop
         # legitimately — the watchdog must not count it as a hang
@@ -1487,9 +1577,12 @@ def run_benchmark(
             if cfg.keep_checkpoints and cfg.train_dir:
                 from tpu_hc_bench.utils import checkpoint as ckpt_mod
 
+                # writer barrier: retention must never reap the .tmp an
+                # in-flight overlapped save is still committing into
                 ckpt_mod.gc_checkpoints(cfg.train_dir,
                                         cfg.keep_checkpoints,
-                                        print_fn=print_fn)
+                                        print_fn=print_fn,
+                                        writer=async_ckpt)
         finally:
             phases.enter("step", step=i)
             if dog is not None:
@@ -1525,11 +1618,14 @@ def run_benchmark(
                     print_fn)
             obs_writer.event("emergency_ckpt", step=completed)
         obs_writer.event("preempt", step=completed,
-                         signal=preempt_h.signum, checkpoint_saved=saved)
+                         signal=preempt_h.signum, checkpoint_saved=saved,
+                         world=topo_rec.get("world"),
+                         arm=topo_rec.get("variable_update"))
         phases.end(step=completed)
         obs_writer.close()
         fleet_writer.close()
-        raise preempt_mod.PreemptedError(completed, saved, preempt_h.signum)
+        raise preempt_mod.PreemptedError(completed, saved, preempt_h.signum,
+                                         topology=topo_rec)
 
     guard_seen_total = 0
     guard_last_poll_i = 0
@@ -1843,6 +1939,7 @@ def run_benchmark(
                          for k, v in ledger.seconds.items() if v > 0.0}
                         if ledger is not None else None),
         mfu_source=mfu_rep["mfu_source"],
+        resume=resume_rec,
     )
     tsum = trace_window.post_summary()
     trace_rec = None

@@ -193,6 +193,61 @@ def make_zero1_state(model, cfg: BenchmarkConfig, example_batch: tuple,
     return base.replace(opt_state=jax.jit(base.tx.init)(stacked))
 
 
+def zero1_opt_template(params, tx, num_shards: int):
+    """Host zero-filled optimizer-state template in the zero1 stacked
+    layout for ``num_shards`` devices — the restore target when a
+    checkpoint was saved under a DIFFERENT world size
+    (``utils.checkpoint.restore_elastic``): the on-disk ``[N_saved, k]``
+    leaves restore into this, then ``resplit_zero1_opt`` re-lays them
+    out for the live world.  Pure ``eval_shape`` + ``np.zeros`` — no
+    device memory."""
+    stacked = jax.eval_shape(
+        lambda p: jax.tree.map(
+            lambda x: _stack_param_shards(x, num_shards), p), params)
+    shapes = jax.eval_shape(tx.init, stacked)
+    return jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), shapes)
+
+
+def resplit_zero1_opt(opt_state, params, tx, n_old: int, n_new: int):
+    """Re-layout a gathered zero1 optimizer state from ``[n_old, k]``
+    stacked shards to ``[n_new, k']`` — the elastic-resume reshard.
+
+    Stacked leaves are identified by comparing abstract ``tx.init``
+    templates over the n_old-stacked vs n_new-stacked params: a leaf
+    whose shapes AGREE between the two is stacking-invariant (scalar
+    counts, schedule state — their shapes never depend on N; and when
+    ``n_old == n_new`` every leaf trivially agrees and the identity is
+    correct), because for a genuinely stacked leaf
+    ``(n_old, ceil(s/n_old)) == (n_new, ceil(s/n_new))`` forces
+    ``n_old == n_new``.  Comparing against the RAW-params template
+    instead would misclassify any param whose own shape coincides with
+    its stacked layout (e.g. a ``(n_old, k)`` kernel) and silently skip
+    its resplit.  Stacked leaves are resplit on host via
+    ``collectives.zero1_resplit_rows`` (strip old padding, re-pad for
+    the new axis) — bitwise on the real elements in both directions.
+    """
+    from tpu_hc_bench.parallel.collectives import zero1_resplit_rows
+
+    def stacked_opt_abs(n):
+        stacked = jax.eval_shape(
+            lambda p: jax.tree.map(
+                lambda x: _stack_param_shards(x, n), p), params)
+        return jax.eval_shape(tx.init, stacked)
+
+    old_abs = stacked_opt_abs(n_old)
+    new_abs = stacked_opt_abs(n_new)
+    ref_abs = jax.eval_shape(tx.init, params)
+
+    def conv(leaf, old_s, new_s, ref_s):
+        if tuple(old_s.shape) == tuple(new_s.shape):
+            return leaf        # stacking-invariant (or n_old == n_new)
+        size = int(np.prod(ref_s.shape)) if ref_s.shape else 1
+        return zero1_resplit_rows(np.asarray(jax.device_get(leaf)),
+                                  size, n_new)
+
+    return jax.tree.map(conv, opt_state, old_abs, new_abs, ref_abs)
+
+
 def zero1_opt_specs(opt_state, num_shards: int):
     """PartitionSpec pytree for a zero1 optimizer state: stacked
     ``[N, ...]`` array leaves shard over the data axis, scalars (step
