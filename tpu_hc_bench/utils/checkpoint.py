@@ -33,6 +33,19 @@ threshold — but a zero1 checkpoint is not interchangeable with a
 psum/replicated one (different opt-state tree; the structure mismatch
 fails loudly at restore).
 
+Topology sidecars + elastic restore (round 12): every save records a
+small ``step_<n>.topology.json`` next to the commit sentinel — world
+size, mesh shape, variable-update arm, PP degree, on-disk layout,
+dtype policy (``topology.topology_record``).  ``restore`` validates it
+against the live topology (``expect_topology``) and raises ONE loud
+:class:`TopologyMismatchError` naming both sides instead of the opaque
+Orbax sharding error a mismatched restore used to die with.
+``restore_elastic`` is the reshape path (``--resume=elastic``):
+host-layout replicated trees drop straight onto the new mesh, and
+zero1's gathered ``[N, k]`` optimizer shards are resplit to the new
+world size (``train.step.resplit_zero1_opt``) before placement.  The
+compatibility matrix is ``topology.elastic_plan``.
+
 Async saves (round 10): a synchronous ``save`` blocks the step loop
 for snapshot + Orbax write + fsync + commit, but only the *snapshot*
 actually needs the step loop stopped — the write targets host memory
@@ -52,6 +65,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import json
 import os
 import re
 import shutil
@@ -68,6 +82,12 @@ from tpu_hc_bench.train.step import TrainState
 _STEP_RE = re.compile(r"step_(\d+)")
 
 
+class TopologyMismatchError(ValueError):
+    """A checkpoint's recorded topology does not fit the live one (and
+    the caller did not ask for — or the transition does not support —
+    an elastic reshape)."""
+
+
 def _step_dir(base: Path, step: int) -> Path:
     return base / f"step_{step:08d}"
 
@@ -76,6 +96,12 @@ def _marker(base: Path, step: int) -> Path:
     """The completion sentinel: ``step_<n>.complete`` NEXT TO the step
     directory (inside it would pollute the Orbax tree)."""
     return base / f"step_{step:08d}.complete"
+
+
+def _topology_sidecar(base: Path, step: int) -> Path:
+    """The topology sidecar: ``step_<n>.topology.json`` next to the
+    sentinel (same placement rationale)."""
+    return base / f"step_{step:08d}.topology.json"
 
 
 def _fsync_path(path: Path) -> None:
@@ -102,8 +128,13 @@ def _marker_id(marker: Path) -> tuple | None:
 
 
 def _commit_step_dir(base: Path, step: int, tmp: Path,
-                     stale_id: tuple | None = None) -> Path:
+                     stale_id: tuple | None = None,
+                     topology: dict | None = None) -> Path:
     """tmp dir -> final dir -> sentinel, each durably ordered.
+    ``topology`` (when given) is written as the ``step_<n>.topology.json``
+    sidecar BEFORE the sentinel, so a complete checkpoint always carries
+    its sidecar; a topology-less re-save of the same step removes any
+    stale sidecar instead of leaving one that lies.
 
     The prior sentinel (if any) is only touched HERE, after the full
     Orbax write landed in ``tmp`` — a crash during the long write
@@ -131,6 +162,15 @@ def _commit_step_dir(base: Path, step: int, tmp: Path,
     if final.exists():
         shutil.rmtree(final)
     os.replace(tmp, final)
+    side = _topology_sidecar(base, step)
+    if topology is not None:
+        with open(side, "w") as f:
+            json.dump(topology, f, indent=2, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+    else:
+        side.unlink(missing_ok=True)
     with open(marker, "w") as f:
         f.write("ok\n")
         f.flush()
@@ -168,23 +208,24 @@ def snapshot_to_host(state: TrainState) -> tuple[int, dict]:
 
 
 def write_host_payload(payload: dict, directory: str | Path,
-                       step: int) -> Path:
+                       step: int, topology: dict | None = None) -> Path:
     """Orbax-write a payload under the commit protocol (tmp dir →
-    rename → sentinel).  The payload is host arrays (the async writer's
-    snapshot — pure host/filesystem work, safe off the main thread) or
-    live ``jax.Array``s (the sharded path: Orbax writes each process's
-    addressable shards and synchronizes internally)."""
+    rename → topology sidecar → sentinel).  The payload is host arrays
+    (the async writer's snapshot — pure host/filesystem work, safe off
+    the main thread) or live ``jax.Array``s (the sharded path: Orbax
+    writes each process's addressable shards and synchronizes
+    internally)."""
     base = Path(directory)
     base.mkdir(parents=True, exist_ok=True)
     tmp = base / (_step_dir(base, step).name + ".tmp")
     stale_id = _marker_id(_marker(base, step))
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(tmp.resolve(), payload, force=True)
-    return _commit_step_dir(base, step, tmp, stale_id)
+    return _commit_step_dir(base, step, tmp, stale_id, topology=topology)
 
 
 def save(state: TrainState, directory: str | Path,
-         sharded: bool = False) -> Path:
+         sharded: bool = False, topology: dict | None = None) -> Path:
     """Save the array state of `state` at its current step.
 
     ``sharded=True`` (multi-host model-sharded states): the LIVE
@@ -192,6 +233,8 @@ def save(state: TrainState, directory: str | Path,
     addressable shards and synchronizes internally — every process must
     call.  Default (host) mode device_gets first, which requires the
     state to be fully addressable (replicated or single-process).
+    ``topology``: the elastic-resume sidecar record
+    (``topology.topology_record``), committed next to the sentinel.
     """
     if sharded:
         step = int(jax.device_get(state.step))
@@ -203,7 +246,7 @@ def save(state: TrainState, directory: str | Path,
         }
     else:
         step, payload = snapshot_to_host(state)
-    return write_host_payload(payload, directory, step)
+    return write_host_payload(payload, directory, step, topology=topology)
 
 
 class AsyncCheckpointWriter:
@@ -243,7 +286,8 @@ class AsyncCheckpointWriter:
     def in_flight(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
-    def submit(self, state: TrainState, gc_keep: int = 0) -> int:
+    def submit(self, state: TrainState, gc_keep: int = 0,
+               topology: dict | None = None) -> int:
         """Barrier on the previous save, snapshot, hand off.  Returns
         the snapshotted step.  Blocking cost: the previous write's
         remaining tail (usually zero — one save per sync window leaves
@@ -251,12 +295,13 @@ class AsyncCheckpointWriter:
         self.wait()
         step, payload = snapshot_to_host(state)
         self._thread = threading.Thread(
-            target=self._write, args=(step, payload, gc_keep),
+            target=self._write, args=(step, payload, gc_keep, topology),
             name=f"tpu-hc-bench-ckpt-writer-{step}", daemon=True)
         self._thread.start()
         return step
 
-    def _write(self, step: int, payload: dict, gc_keep: int) -> None:
+    def _write(self, step: int, payload: dict, gc_keep: int,
+               topology: dict | None = None) -> None:
         from tpu_hc_bench.resilience.retry import retry_io
 
         t0 = time.monotonic()
@@ -267,9 +312,12 @@ class AsyncCheckpointWriter:
             # Single-process by construction, so retrying is safe
             # (multi-host saves never take the async path).
             path = retry_io(
-                lambda: write_host_payload(payload, self._dir, step),
+                lambda: write_host_payload(payload, self._dir, step,
+                                           topology=topology),
                 what="async checkpoint write", print_fn=self._print)
             if gc_keep:
+                # no writer= here: the GC runs ON the writer thread,
+                # strictly after its own commit landed
                 gc_checkpoints(self._dir, gc_keep, print_fn=self._print)
             dt = time.monotonic() - t0
             self.commits.append(
@@ -320,12 +368,70 @@ def latest_step(directory: str | Path) -> int | None:
     return steps[-1] if steps else None
 
 
+def read_topology(directory: str | Path,
+                  step: int | None = None) -> dict | None:
+    """Load a checkpoint's topology sidecar (None for pre-elastic saves
+    — checkpoints written before the sidecar scheme, or an unreadable
+    file; callers fall back to assuming the saved topology matches)."""
+    base = Path(directory)
+    if step is None:
+        step = latest_step(base)
+        if step is None:
+            return None
+    path = _topology_sidecar(base, step)
+    try:
+        return json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def check_topology(saved: dict, live: dict, directory=None,
+                   step: int | None = None,
+                   elastic: bool = False) -> tuple[str, str]:
+    """Validate a checkpoint's recorded topology against the live one.
+
+    Returns ``(action, plan_line)`` from ``topology.elastic_plan``.
+    Raises :class:`TopologyMismatchError` — ONE loud error naming the
+    saved vs live topology, instead of the opaque Orbax sharding error
+    a mismatched restore used to surface as — when the restore would
+    need an elastic reshard (and ``elastic`` was not requested) or the
+    transition is genuinely incompatible.
+    """
+    from tpu_hc_bench import topology as topo_mod
+
+    action, plan = topo_mod.elastic_plan(saved, live)
+    if action in ("ok", "noop"):
+        return action, plan
+    where = ""
+    if directory is not None:
+        where = f" under {directory}" + (
+            f" (step {step})" if step is not None else "")
+    head = (f"checkpoint topology mismatch{where}: saved "
+            f"{topo_mod.describe_topology(saved)} vs live "
+            f"{topo_mod.describe_topology(live)}")
+    if action == "reshard" and not elastic:
+        raise TopologyMismatchError(
+            f"{head}; relaunch with --resume=elastic to reshape "
+            f"({plan})")
+    if action == "refuse":
+        raise TopologyMismatchError(f"{head} — {plan}")
+    return action, plan
+
+
 def gc_checkpoints(directory: str | Path, keep: int,
-                   print_fn=None) -> list[int]:
+                   print_fn=None, writer=None) -> list[int]:
     """--keep_checkpoints retention: keep the newest ``keep`` complete
     steps, delete the rest plus stale ``.tmp`` partial writes.  Returns
     the deleted step numbers.  Multi-process: process 0 only
     (single-writer, same shared filesystem the saves use).
+
+    ``writer``: the run's :class:`AsyncCheckpointWriter`, if any.  GC
+    barriers on it first — the ``.tmp`` reaping below would otherwise
+    delete the very directory an in-flight overlapped save is still
+    Orbax-writing into, turning that save's commit into a corrupt or
+    failed checkpoint.  (The writer's OWN retention pass runs on the
+    writer thread after its commit and must NOT pass itself — waiting
+    on your own thread is a deadlock.)
 
     Sentinel-less final-name step dirs are deliberately LEFT ALONE:
     they are either crashed renames (rare, small) or checkpoints
@@ -335,6 +441,8 @@ def gc_checkpoints(directory: str | Path, keep: int,
     """
     if keep <= 0:
         return []
+    if writer is not None:
+        writer.wait()
     if jax.process_count() > 1 and jax.process_index() != 0:
         return []
     base = Path(directory)
@@ -344,6 +452,7 @@ def gc_checkpoints(directory: str | Path, keep: int,
         # sentinel first: a crash mid-delete must not leave a sentinel
         # pointing at a half-deleted directory
         _marker(base, step).unlink(missing_ok=True)
+        _topology_sidecar(base, step).unlink(missing_ok=True)
         shutil.rmtree(_step_dir(base, step), ignore_errors=True)
     for p in base.glob("step_*.tmp"):
         shutil.rmtree(p, ignore_errors=True)
@@ -372,7 +481,8 @@ def fingerprint(tree) -> str:
 
 
 def restore(state: TrainState, directory: str | Path,
-            step: int | None = None, sharded: bool = False) -> TrainState:
+            step: int | None = None, sharded: bool = False,
+            expect_topology: dict | None = None) -> TrainState:
     """Restore into an already-constructed (template) TrainState.
 
     ``state`` supplies the tree structure, dtypes, and the non-serializable
@@ -382,6 +492,12 @@ def restore(state: TrainState, directory: str | Path,
     arrays carry shardings); Orbax restores each array with that
     sharding, every process reading only the shards it addresses —
     the multi-host restore for model-sharded states.
+
+    ``expect_topology``: the LIVE topology record.  When given and the
+    checkpoint carries a sidecar, the two are validated up front — a
+    restore that would need a reshard (or is incompatible) dies with
+    one loud :class:`TopologyMismatchError` naming both topologies,
+    not an opaque Orbax sharding/shape error mid-read.
     """
     base = Path(directory)
     if step is None:
@@ -395,6 +511,10 @@ def restore(state: TrainState, directory: str | Path,
             f"checkpoint step {step} under {base} is incomplete (no "
             f"{_marker(base, step).name} sentinel — crashed save?); "
             f"complete steps: {complete_steps(base) or 'none'}")
+    if expect_topology is not None:
+        saved_topo = read_topology(base, step)
+        if saved_topo is not None:
+            check_topology(saved_topo, expect_topology, base, step)
     pull = (lambda t: t) if sharded else jax.device_get
     template = {
         "step": jax.device_get(state.step),
@@ -424,7 +544,40 @@ def restore(state: TrainState, directory: str | Path,
     )
 
 
-def save_pp(params, opt_state, step: int, directory: str | Path) -> Path:
+def restore_elastic(state: TrainState, directory: str | Path,
+                    saved_topology: dict | None, live_world: int,
+                    step: int | None = None) -> TrainState:
+    """Restore a HOST-layout checkpoint saved under a different world
+    size onto the live one (``--resume=elastic``).
+
+    Replicated trees (psum/replicated arms) are world-size neutral on
+    disk — the plain restore already reassembles them; the caller
+    re-places onto the live mesh.  The zero1 arm's gathered optimizer
+    state is stacked ``[N_saved, k]`` per leaf: the restore goes through
+    an old-layout host template (``train.step.zero1_opt_template``) and
+    the shards are then resplit to ``[live_world, k']``
+    (``train.step.resplit_zero1_opt``) — strip the old per-leaf zero
+    padding, re-pad for the new axis size — so ``place_zero1_state``
+    onto the new mesh round-trips bitwise.  Multi-host sharded and
+    pp-native layouts never reach here (``topology.elastic_plan``
+    refuses or routes them elsewhere).
+    """
+    if (saved_topology or {}).get("variable_update") == "zero1":
+        from tpu_hc_bench.train import step as step_mod
+
+        n_old = int(saved_topology["world"])
+        old_opt = step_mod.zero1_opt_template(state.params, state.tx, n_old)
+        restored = restore(state.replace(opt_state=old_opt), directory,
+                           step=step)
+        new_opt = step_mod.resplit_zero1_opt(
+            restored.opt_state, state.params, state.tx, n_old,
+            int(live_world))
+        return restored.replace(opt_state=new_opt)
+    return restore(state, directory, step=step)
+
+
+def save_pp(params, opt_state, step: int, directory: str | Path,
+            topology: dict | None = None) -> Path:
     """Multi-host PP checkpoint: the PP-NATIVE stacked layout, sharded.
 
     The DP<->PP checkpoint interchange (pipeline.pp_state_from_train_state)
@@ -449,7 +602,8 @@ def save_pp(params, opt_state, step: int, directory: str | Path) -> Path:
     ckptr.save((tmp / "pp_params").resolve(), params, force=True)
     if opt_state is not None:
         ckptr.save((tmp / "opt_state").resolve(), opt_state, force=True)
-    return _commit_step_dir(base, int(step), tmp, stale_id)
+    return _commit_step_dir(base, int(step), tmp, stale_id,
+                            topology=topology)
 
 
 def restore_pp(params, opt_state, directory: str | Path,
