@@ -68,6 +68,11 @@ def main() -> int:
         # resume identity rides the JSON `extra` either way
         train_dir=os.environ.get("BENCH_TRAIN_DIR") or None,
         resume=os.environ.get("BENCH_RESUME", "auto"),
+        # round 13: host-level shared input service A/B on real-data
+        # bench runs (BENCH_DATA_DIR + BENCH_INPUT_SERVICE=on|off|auto);
+        # synthetic runs resolve the flag to off with a translation note
+        data_dir=os.environ.get("BENCH_DATA_DIR") or None,
+        input_service=os.environ.get("BENCH_INPUT_SERVICE", "auto"),
     ).resolve()
 
     # human-readable progress to stderr; stdout carries only the JSON line
@@ -113,6 +118,16 @@ def main() -> int:
             "goodput": (round(result.goodput, 4)
                         if result.goodput == result.goodput else None),
             "goodput_phases": result.goodput_phases,
+            # input plane: which arm ACTUALLY fed the run (the driver
+            # resolves --input_service=auto, so the flag string alone
+            # can't distinguish arms; true/false/null-resolved) + the
+            # ledger's data_wait fraction — the input-service success
+            # metric (~0 as workers-per-host scale)
+            "input_service": result.input_service,
+            "input_service_flag": cfg.input_service,
+            "data_wait_frac": (round(result.data_wait_frac, 4)
+                               if result.data_wait_frac
+                               == result.data_wait_frac else None),
             # resume topology (saved world -> live world, arm): a
             # post-resume throughput shift with a world-size change is
             # a different experiment — obs diff and the BENCH history

@@ -30,6 +30,12 @@ shipped) are checked statically:
   save recorded the world/mesh/arm it was written under; a save path
   added without the sidecar silently produces checkpoints that resume
   on the identical mesh only.
+- **input-pool-width** (warning): an ImageNet/TFRecord pipeline
+  constructed with an explicit decode pool wider than the host budget
+  cap (``max(32, cpu_count())`` — machine-stable up to 32 cores), or a
+  full-host-width *private* pool — at workers-per-host > 1 the
+  per-process pools oversubscribe the CPUs and bypass the shared input
+  service's one-pool-per-host budget (``data/service.py``).
 - **sharding-consistency** (warning): per model, the Megatron
   annotation table (``train.step.tp_param_spec``) is replayed against
   the abstractly-initialized param tree: a rule whose *name* matches a
@@ -47,6 +53,7 @@ from __future__ import annotations
 
 import ast
 import functools
+import os
 import symtable
 from pathlib import Path
 
@@ -63,7 +70,9 @@ DONATION = "donated-buffer-misuse"
 SHARDING = "sharding-consistency"
 COLLECTIVE_SHAPE = "collective-shape"
 CKPT_TOPOLOGY = "checkpoint-topology"
-ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY)
+INPUT_POOL = "input-pool-width"
+ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
+                    INPUT_POOL)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -120,10 +129,12 @@ def _callee_basename(call: ast.Call) -> str:
 class _FileLinter:
     """All AST passes over one Python source file."""
 
-    def __init__(self, source: str, filename: str, model: str = "repo"):
+    def __init__(self, source: str, filename: str, model: str = "repo",
+                 cpu_count: int | None = None):
         self.source = source
         self.filename = filename
         self.model = model
+        self.cpu_count = cpu_count or (os.cpu_count() or 1)
         self.tree = ast.parse(source, filename=filename)
         self.suppressed = _suppressed_lines(source)
         try:
@@ -485,6 +496,69 @@ class _FileLinter:
                 "elastic resume; pass topology.topology_record(...) "
                 "(or None deliberately, with a thb:lint-ok note)")
 
+    # -- pass: input decode-pool width ---------------------------------
+
+    # call sites that construct a per-worker input pipeline with its
+    # own decode pool (the service factories own the HOST budget and
+    # are deliberately exempt)
+    _INPUT_PIPELINE_CALLEES = {"ImageNetDataset"}
+
+    def _check_input_pool(self):
+        """An ImageNet/TFRecord pipeline constructed with an explicit
+        decode pool wider than the host, or a full-host-width private
+        pool — at workers-per-host > 1 either oversubscribes the CPUs
+        the input service exists to budget (``--input_service=on``
+        routes every worker through ONE host pool).
+
+        The explicit-constant threshold is ``max(32, cpu_count)`` — 32
+        is the data layer's own pool cap (``imagenet
+        .host_decode_budget``), so the verdict on a literal width is
+        stable across dev/CI machines up to 32 cores instead of
+        flapping with whatever host happens to run the gate.
+        """
+        limit = max(32, self.cpu_count)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_basename(node) not in self._INPUT_PIPELINE_CALLEES:
+                continue
+            for kw in node.keywords:
+                if kw.arg != "decode_workers":
+                    continue
+                v = kw.value
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int) \
+                        and v.value > limit:
+                    self._emit(
+                        INPUT_POOL, "warning", node,
+                        f"explicit decode pool width {v.value} exceeds "
+                        f"the host budget cap max(32, cpu_count)="
+                        f"{limit} — the pool oversubscribes the host; "
+                        "size the host budget via "
+                        "--service_decode_workers (input service) or "
+                        "divide by the local worker count")
+                elif self._full_width_expr(v):
+                    self._emit(
+                        INPUT_POOL, "warning", node,
+                        "private decode pool sized to the FULL host "
+                        "(cpu_count()) — at workers-per-host > 1 the "
+                        "per-process pools oversubscribe the CPUs and "
+                        "bypass the shared input service's one-pool-per-"
+                        "host budget; route input through data.service "
+                        "or divide the width by the local worker count")
+
+    @staticmethod
+    def _full_width_expr(node: ast.AST) -> bool:
+        has_cpu = any(
+            isinstance(n, ast.Call)
+            and _dotted(n.func).rsplit(".", 1)[-1] == "cpu_count"
+            for n in ast.walk(node))
+        divided = any(
+            isinstance(n, ast.BinOp)
+            and isinstance(n.op, (ast.FloorDiv, ast.Div))
+            for n in ast.walk(node))
+        return has_cpu and not divided
+
     # -- driver --------------------------------------------------------
 
     def run(self) -> list[Finding]:
@@ -493,13 +567,17 @@ class _FileLinter:
             self._check_recompile(ctx)
         self._check_donation()
         self._check_checkpoint_topology()
+        self._check_input_pool()
         return self.findings
 
 
 def lint_source_text(source: str, filename: str = "<string>",
-                     model: str = "repo") -> list[Finding]:
-    """AST lint passes over a source string (the test-fixture entry)."""
-    return _FileLinter(source, filename, model).run()
+                     model: str = "repo",
+                     cpu_count: int | None = None) -> list[Finding]:
+    """AST lint passes over a source string (the test-fixture entry).
+    ``cpu_count`` pins the input-pool-width threshold for deterministic
+    tests (default: this host's)."""
+    return _FileLinter(source, filename, model, cpu_count=cpu_count).run()
 
 
 def lint_file(path: str | Path, model: str = "repo") -> list[Finding]:

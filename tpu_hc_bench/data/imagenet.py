@@ -40,6 +40,14 @@ IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32) * 255.0
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32) * 255.0
 
 
+def host_decode_budget() -> int:
+    """The ONE home of the host decode budget: ``cpu_count()-1``
+    threads (one core left for step loops), capped at 32.  The shared
+    input service claims it whole; a per-process pipeline's auto width
+    is this divided by the local worker count."""
+    return max(1, min(32, (os.cpu_count() or 2) - 1))
+
+
 def find_shards(data_dir: str | Path, split: str = "train") -> list[str]:
     """Locate TFRecord shards (`train-00000-of-01024` style, or any files
     matching `<split>*`)."""
@@ -174,6 +182,9 @@ class ImageNetDataset:
         labels_zero_based: bool = False,
         wire_dtype: str = "float32",
         decode_workers: int | None = None,
+        local_workers: int | None = None,
+        decode_pool: "ThreadPoolExecutor | None" = None,
+        decode_rows: tuple[int, int] | None = None,
     ):
         if wire_dtype not in ("float32", "uint8"):
             raise ValueError(f"wire_dtype must be float32|uint8: {wire_dtype}")
@@ -191,10 +202,33 @@ class ImageNetDataset:
         self.wire_dtype = wire_dtype
         # decode pool width (tf_cnn_benchmarks --datasets_num_private_threads
         # analog); 0/None = auto-size to the host's cores (matching the CLI
-        # flag's 0=auto convention), 1 = serial
+        # flag's 0=auto convention), 1 = serial.  ``local_workers``: how many
+        # worker processes share this host — the auto width divides the host
+        # budget by it, so N private pools never claim N*(cpu-1) threads
+        # (the oversubscription the shared input service removes entirely).
         if not decode_workers:
-            decode_workers = max(1, min(32, (os.cpu_count() or 2) - 1))
+            share = max(1, int(local_workers or 1))
+            decode_workers = max(1, host_decode_budget() // share)
         self.decode_workers = decode_workers
+        # an externally owned pool (the host input service's shared pool):
+        # _batches submits here instead of spinning a private pool, and
+        # never shuts it down
+        self._decode_pool = decode_pool
+        # decode only batch rows [lo, hi): the multi-process driver has
+        # each worker decode the FULL global batch while its devices
+        # consume one slice — the host input service's sliced mode
+        # decodes just the consumed rows (records are still read/parsed
+        # and the per-row RNG stream still advances, so the decoded
+        # rows are bitwise-identical to the full pipeline's).  Rows
+        # outside the slice are UNDEFINED memory — the caller must
+        # slice them away before delivery.
+        if decode_rows is not None:
+            lo, hi = decode_rows
+            if not (0 <= lo < hi <= global_batch):
+                raise ValueError(
+                    f"decode_rows {decode_rows} out of range for "
+                    f"global_batch {global_batch}")
+        self.decode_rows = decode_rows
         # decode-pool counters (obs.metrics "data" record): written by the
         # producer thread, read by the driver after the run — scalar
         # updates under the GIL, no lock needed
@@ -245,8 +279,12 @@ class ImageNetDataset:
                                          normalize=normalize)
             labels[i] = label
 
-        pool = (ThreadPoolExecutor(self.decode_workers)
-                if self.decode_workers > 1 else None)
+        own_pool = None
+        if self._decode_pool is not None:
+            pool = self._decode_pool
+        else:
+            own_pool = pool = (ThreadPoolExecutor(self.decode_workers)
+                               if self.decode_workers > 1 else None)
         stream_idx = 0
         try:
             while True:
@@ -256,23 +294,47 @@ class ImageNetDataset:
                 items = []
                 for i in range(self.global_batch):
                     jpeg, label = next(stream)
+                    labels[i] = label
                     items.append((i, jpeg, label, stream_idx))
                     stream_idx += 1
+                if self.decode_rows is not None:
+                    # sliced mode: the RNG stream above advanced over
+                    # EVERY row (bitwise alignment with the full
+                    # pipeline); only the consumed rows pay decode
+                    lo, hi = self.decode_rows
+                    items = [it for it in items if lo <= it[0] < hi]
                 if pool is None:
                     for it in items:
                         decode_into(images, labels, *it)
                 else:
-                    futs = [pool.submit(decode_into, images, labels, *it)
-                            for it in items]
+                    # one task per pool thread, not per image: executor
+                    # submit/result costs ~50-100us of GIL each, and at
+                    # host-pool rates (the shared input service pushes
+                    # thousands of img/s through ONE process) per-image
+                    # futures convoy the GIL.  Chunking is invisible to
+                    # the output: each image's augmentation RNG is keyed
+                    # by its stream index, not by task placement.
+                    width = max(1, getattr(pool, "_max_workers",
+                                           self.decode_workers))
+                    step_ = -(-len(items) // width)
+                    chunks = [items[i:i + step_]
+                              for i in range(0, len(items), step_)]
+
+                    def decode_chunk(chunk):
+                        for it in chunk:
+                            decode_into(images, labels, *it)
+
+                    futs = [pool.submit(decode_chunk, c) for c in chunks]
                     for f in futs:
                         f.result()   # re-raises decode errors here
                 self._batches_decoded += 1
-                self._examples_decoded += self.global_batch
+                self._examples_decoded += len(items)   # sliced mode: only
+                                                       # the decoded rows
                 self._decode_wall_s += time.perf_counter() - t0
                 yield images, labels
         finally:
-            if pool is not None:
-                pool.shutdown(wait=False, cancel_futures=True)
+            if own_pool is not None:
+                own_pool.shutdown(wait=False, cancel_futures=True)
 
     def stats(self) -> dict:
         """Decode-pool counters for the run's metrics artifact.

@@ -127,6 +127,144 @@ class TokenDataset:
             step += 1
 
 
+# ---------------------------------------------------------------------
+# packed / ragged sequence batching (round 13, input-service side)
+#
+# Variable-length documents padded to a per-batch max are the classic
+# recompile generator: every new max length is a new XLA program.  The
+# input service packs documents into ONE fixed bucket host-side —
+# greedy first-fit in arrival order, long documents chunked — so the
+# consumer only ever sees a single (batch, seq_len) shape.  Segment ids
+# and in-segment positions ride along; loss weights zero out pad slots
+# and the cross-document next-token positions.
+
+
+def split_documents(tokens: np.ndarray, eod_id: int) -> list[np.ndarray]:
+    """Split a flat token stream into documents on ``eod_id``.
+
+    Each document KEEPS its trailing end-of-document token (the
+    nanoGPT/Megatron convention); a trailing partial document (no eod
+    yet) is kept too.  Empty documents (consecutive eods) are dropped.
+    """
+    tokens = np.asarray(tokens)
+    ends = np.flatnonzero(tokens == eod_id)
+    docs: list[np.ndarray] = []
+    start = 0
+    for e in ends:
+        if e > start:       # e == start is a consecutive eod: empty doc
+            docs.append(tokens[start:e + 1])
+        start = e + 1
+    if start < len(tokens):
+        docs.append(tokens[start:])
+    return docs
+
+
+def pack_sequences(docs: list[np.ndarray], seq_len: int,
+                   pad_id: int = 0) -> dict[str, np.ndarray]:
+    """Pack documents into fixed ``seq_len`` rows (greedy first-fit in
+    arrival order; documents longer than ``seq_len`` are chunked).
+
+    Returns ``tokens`` [N, L] int32, ``segment_ids`` [N, L] int32
+    (1-based per-row document index, 0 = padding), and ``positions``
+    [N, L] int32 (0-based offset within the segment).  Deterministic:
+    row layout depends only on the document sequence.
+    """
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1: {seq_len}")
+    rows: list[list[np.ndarray]] = []
+    space: list[int] = []           # free slots per row
+    for doc in docs:
+        doc = np.asarray(doc)
+        for i in range(0, len(doc), seq_len):
+            chunk = doc[i:i + seq_len]
+            for r, free in enumerate(space):
+                if len(chunk) <= free:
+                    rows[r].append(chunk)
+                    space[r] -= len(chunk)
+                    break
+            else:
+                rows.append([chunk])
+                space.append(seq_len - len(chunk))
+    n = len(rows)
+    tokens = np.full((n, seq_len), pad_id, np.int32)
+    segment_ids = np.zeros((n, seq_len), np.int32)
+    positions = np.zeros((n, seq_len), np.int32)
+    for r, segs in enumerate(rows):
+        off = 0
+        for s, seg in enumerate(segs, start=1):
+            tokens[r, off:off + len(seg)] = seg
+            segment_ids[r, off:off + len(seg)] = s
+            positions[r, off:off + len(seg)] = np.arange(len(seg))
+            off += len(seg)
+    return {"tokens": tokens, "segment_ids": segment_ids,
+            "positions": positions}
+
+
+@dataclasses.dataclass
+class PackedTokenDataset:
+    """Endless iterator of FIXED-SHAPE packed causal batches
+    ``(tokens, targets, weights, segment_ids)`` from a memory-mapped
+    corpus whose documents are delimited by ``eod_id``.
+
+    Every batch is ``[global_batch, seq_len]`` — the one bucket the
+    service publishes, so consumers never recompile.  Weights mask
+    padding and the next-token positions that would cross a document
+    boundary.  Deterministic per ``(seed, worker, step)`` like
+    ``TokenDataset``.
+    """
+
+    data_dir: str | Path
+    global_batch: int
+    seq_len: int
+    eod_id: int = 0
+    split: str = "train"
+    worker: int = 0
+    num_workers: int = 1
+    seed: int = 0
+
+    def __post_init__(self):
+        path, dtype = _resolve(self.data_dir, self.split)
+        data = np.memmap(path, dtype=dtype, mode="r")
+        shard = len(data) // self.num_workers
+        lo = self.worker * shard
+        self._data = data[lo:lo + shard]
+        # draw window: enough raw stream to fill the bucket even after
+        # packing losses (greedy first-fit wastes < one doc per row)
+        self._draw = min(len(self._data),
+                         2 * self.global_batch * (self.seq_len + 1))
+        if len(self._data) < self.seq_len + 1:
+            raise ValueError(
+                f"{path}: worker shard has {len(self._data)} tokens < "
+                f"window {self.seq_len + 1}")
+
+    def batch(self, step: int = 0) -> tuple[np.ndarray, ...]:
+        rng = np.random.default_rng((self.seed, self.worker, step))
+        start = int(rng.integers(0, len(self._data) - self._draw + 1))
+        window = np.asarray(self._data[start:start + self._draw])
+        docs = split_documents(window, self.eod_id)
+        packed = pack_sequences(docs, self.seq_len + 1)
+        b, lw = self.global_batch, self.seq_len + 1
+        toks = np.zeros((b, lw), np.int32)
+        segs = np.zeros((b, lw), np.int32)
+        n = min(b, len(packed["tokens"]))
+        toks[:n] = packed["tokens"][:n]
+        segs[:n] = packed["segment_ids"][:n]
+        tokens, targets = toks[:, :-1], toks[:, 1:]
+        seg_t, seg_n = segs[:, :-1], segs[:, 1:]
+        # a target counts only when it continues the SAME document (and
+        # neither side is padding)
+        weights = ((seg_t != 0) & (seg_t == seg_n)).astype(np.float32)
+        return (np.ascontiguousarray(tokens),
+                np.ascontiguousarray(targets), weights,
+                np.ascontiguousarray(seg_t))
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, ...]]:
+        step = 0
+        while True:
+            yield self.batch(step)
+            step += 1
+
+
 def main(argv=None) -> int:
     """Operator CLI: write a corpus in the wire format.
 

@@ -311,7 +311,25 @@ class BenchmarkConfig:
                                               # lookahead (real-data runs):
                                               # batches kept in flight so
                                               # decode + DMA overlap the
-                                              # running step
+                                              # running step; also the
+                                              # double-buffer depth of the
+                                              # host decode queue and the
+                                              # input-service ring slots
+
+    # --- host-level shared input service (round 13) ---
+    input_service: str = "auto"               # on|off|auto: one decode pool
+                                              # per host serving all local
+                                              # workers over shared-memory
+                                              # batch rings (data/service.py)
+                                              # instead of a private pool
+                                              # per process.  auto = on when
+                                              # >1 worker shares the host;
+                                              # off = the per-process
+                                              # pipeline (the control arm)
+    service_decode_workers: int = 0           # width of the HOST decode
+                                              # pool under the service
+                                              # (0 = auto: cpu_count-1 for
+                                              # the whole host)
 
     # --- resilience (round 8; no reference analog — SURVEY.md §5 notes
     # the reference just dies) ---
@@ -588,6 +606,54 @@ class BenchmarkConfig:
             raise ValueError(
                 f"--prefetch_depth must be >= 1 (1 = no lookahead): "
                 f"{self.prefetch_depth}")
+        # --- input service (round 13): config-resolvable exclusions
+        # translate loudly here; world-shape ones (multi-host grouping)
+        # are only known to the driver ---
+        if self.input_service not in ("on", "off", "auto"):
+            raise ValueError(
+                f"--input_service must be on|off|auto: "
+                f"{self.input_service!r}")
+        if self.service_decode_workers < 0:
+            raise ValueError(
+                f"--service_decode_workers must be >= 0 (0 = auto): "
+                f"{self.service_decode_workers}")
+        if self.input_service == "on":
+            is_text = False
+            if self.data_dir is not None:
+                from tpu_hc_bench.models import get_model_spec
+
+                try:
+                    is_text = get_model_spec(self.model).is_text
+                except ValueError:
+                    pass    # unknown model: let create_model raise later
+            if self.data_dir is None:
+                t["input_service"] = ("on->off (synthetic input has no "
+                                      "host decode pipeline to share)")
+                self.input_service = "off"
+            elif is_text:
+                # loud, not silent: the driver's service arm covers the
+                # image TFRecord path; text members read a memmapped
+                # corpus per-process (page-cache-shared, no decode) —
+                # the packed-token service exists at the API level only
+                # (data.service.make_packed_token_service)
+                t["input_service"] = (
+                    "on->off (text members read a memmapped corpus "
+                    "per-process; the packed-token service is not "
+                    "driver-wired yet — see "
+                    "data.service.make_packed_token_service)")
+                self.input_service = "off"
+            elif self.datasets_repeat_cached_sample:
+                t["input_service"] = (
+                    "on->off (--datasets_repeat_cached_sample decodes a "
+                    "handful of batches once and shuts the pipeline down "
+                    "— nothing to serve)")
+                self.input_service = "off"
+            elif self.eval:
+                t["input_service"] = (
+                    "on->off (--eval reads the validation split "
+                    "per-process; the service targets the sustained "
+                    "training input plane)")
+                self.input_service = "off"
         # --compile_cache stays filesystem-pure here (same principle as
         # --fabric_ceiling): the driver resolves auto/off and creates the
         # directory at run start
@@ -692,7 +758,9 @@ class BenchmarkConfig:
             + (" [repeat_cached_sample]"
                if self.datasets_repeat_cached_sample else "")
             + f" ({self.data_name}, {self.data_format})"
-            + f" prefetch_depth={self.prefetch_depth}",
+            + f" prefetch_depth={self.prefetch_depth}"
+            + (f" input_service={self.input_service}"
+               if self.data_dir is not None else ""),
             f"variable_update={self.variable_update} "
             f"fusion_threshold={self.fusion_threshold_bytes}B"
             + (f" overlap_grad_comm={self.overlap_grad_comm}"
@@ -766,6 +834,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compile_cache", type=str, default=d.compile_cache,
                    metavar="DIR|off")
     p.add_argument("--prefetch_depth", type=int, default=d.prefetch_depth)
+    p.add_argument("--input_service", type=str, default=d.input_service,
+                   choices=["on", "off", "auto"])
+    p.add_argument("--service_decode_workers", type=int,
+                   default=d.service_decode_workers)
     p.add_argument("--on_nonfinite", type=str, default=d.on_nonfinite,
                    choices=["abort", "skip", "rewind"])
     p.add_argument("--max_bad_steps", type=int, default=d.max_bad_steps)

@@ -197,6 +197,49 @@ def read_heartbeats(run_dir: str) -> dict[int, list[dict]]:
     return out
 
 
+def input_lines(run_dir: str | None, records: list[dict],
+                ledger=None) -> list[str]:
+    """The ``summarize`` input-plane account (real-data runs only):
+    data_wait fraction from the goodput ledger, the input service's
+    ring occupancy/stall record, and per-host ring occupancy mined from
+    the heartbeats' ``input`` fields."""
+    svc = [r for r in records if r.get("kind") == "input_service"]
+    data = [r for r in records if r.get("kind") == "data"]
+    if not svc and not data:
+        return []                   # synthetic input: no input plane
+    head = "  input:"
+    if ledger is not None and ledger.wall_s > 0:
+        dw = ledger.seconds.get("data_wait", 0.0)
+        head += f" data_wait {dw / ledger.wall_s:.1%} of wall"
+    if svc:
+        s = svc[-1]
+        depth = s.get("depth", "?")
+        head += (f"  service rings occ p50 {s.get('occ_p50', 0)}/{depth} "
+                 f"p99 {s.get('occ_p99', 0)}/{depth}  producer stalls "
+                 f"{s.get('producer_stall_s', 0.0):.2f}s  consumer waits "
+                 f"{s.get('consumer_wait_s', 0.0):.2f}s  "
+                 f"({s.get('decode_workers', '?')} decode thread(s) -> "
+                 f"{s.get('workers', '?')} worker(s))")
+    else:
+        head += " (per-process pipeline)"
+    lines = [head]
+    beats = read_heartbeats(run_dir) if run_dir else {}
+    occ = sorted(
+        rec["input"]["ring_occ"]
+        for recs in beats.values() for rec in recs
+        if isinstance(rec.get("input"), dict)
+        and "ring_occ" in rec["input"])
+    if occ:
+        def pct(q):
+            return occ[min(len(occ) - 1, int(q * (len(occ) - 1)))]
+
+        lines.append(
+            f"    host rings (heartbeats): occ p50 {pct(0.5)} "
+            f"p99 {pct(0.99)} over {len(occ)} window(s), "
+            f"{len(beats)} host(s)")
+    return lines
+
+
 def straggler_lines(run_dir: str, records: list[dict]) -> list[str]:
     """Fleet lines for ``summarize``: the last in-stream ``straggler``
     record (collective-sampled, clock-free) plus the per-host heartbeat

@@ -399,6 +399,7 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
         lines.append(f"  async checkpoints: {len(commits)} landed, "
                      f"{total_w:.2f}s of writes overlapped with the "
                      f"step loop")
+    run_dir = None
     try:
         run_dir = os.path.dirname(resolve_run(path)[1])
         lines.extend(fleet_mod.straggler_lines(run_dir, records))
@@ -410,6 +411,9 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
             f"  data: {data.get('examples', 0)} examples decoded, "
             f"{data.get('decode_workers', '?')} workers, "
             f"{data.get('decode_wall_s', 0.0):.1f}s decode wall")
+    # input plane (real-data runs): data_wait fraction + service ring
+    # backpressure — the "is the host keeping the chips fed" line
+    lines.extend(fleet_mod.input_lines(run_dir, records, ledger))
     mem = _last(records, "memory")
     if mem and mem.get("devices"):
         peaks = [v.get("peak_bytes_in_use", 0)
@@ -544,6 +548,15 @@ def diff_runs(path_a: str, path_b: str,
                 vb = led_b.seconds.get(p, 0.0)
                 lines.append(f"  {p:>14s} {va:12.2f} {vb:12.2f} "
                              f"{_pct(va, vb):>8s}")
+        # input-plane delta: the fraction of wall blocked on the input
+        # pipeline — the input-service A/B's headline row
+        fa = (led_a.seconds.get("data_wait", 0.0) / led_a.wall_s
+              if led_a.wall_s > 0 else 0.0)
+        fb = (led_b.seconds.get("data_wait", 0.0) / led_b.wall_s
+              if led_b.wall_s > 0 else 0.0)
+        if fa > 0.0 or fb > 0.0:
+            lines.append(f"  {'data_wait frac':>14s} {fa:12.4f} "
+                         f"{fb:12.4f} {_pct(fa, fb):>8s}")
 
     tb_a = _last(recs_a, "trace_buckets")
     tb_b = _last(recs_b, "trace_buckets")

@@ -13,6 +13,7 @@ step-time stats, and MFU against the chip's peak (BASELINE.md targets).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Callable
 
@@ -69,6 +70,15 @@ class BenchmarkResult:
     # omitted): how the non-productive wall was spent — compile,
     # checkpoint blocking, data waits.  None where no ledger ran.
     goodput_phases: dict | None = None
+    # fraction of wall spent blocked on the input pipeline (the
+    # ledger's data_wait phase / wall seconds); NaN where no ledger ran.
+    # THE input-service success metric: ~0 as workers-per-host scale
+    data_wait_frac: float = float("nan")
+    # which input arm actually fed the run: True = shared host service,
+    # False = per-process pipeline, None = no real-image input plane.
+    # --input_service=auto resolves inside the driver, so the flag
+    # string alone cannot distinguish the arms in a run record
+    input_service: bool | None = None
     # where the MFU's FLOP figure came from: "measured" =
     # compiled.cost_analysis() of the actual step program, "analytic" =
     # the hand-maintained spec.flops_per_example table (obs.efficiency)
@@ -177,6 +187,35 @@ def _resolve_compile_cache(cfg: BenchmarkConfig, print_fn) -> str | None:
     except Exception:
         pass
     return cache_dir
+
+
+def _input_service_on(cfg: BenchmarkConfig, layout) -> bool:
+    """Resolve ``--input_service`` against the world shape.
+
+    ``auto`` turns the service on exactly when >1 worker shares one
+    host (the oversubscription case it exists for); ``on`` with workers
+    spread over several hosts is refused loudly — per-host worker
+    grouping is not derivable here, and a cross-host shm ring is
+    nonsense.  flags.resolve already translated the config-level
+    exclusions (synthetic input, repeat_cached_sample, eval) to off.
+    """
+    if cfg.input_service == "off":
+        return False
+    if cfg.datasets_repeat_cached_sample or cfg.eval:
+        # auto never engages for these (resolve() already translated an
+        # explicit on to off with a note): repeat_cached shuts the
+        # pipeline down after a handful of batches, and eval reads the
+        # validation split per-process
+        return False
+    world = jax.process_count()
+    if cfg.input_service == "on":
+        if world > 1 and layout.num_hosts > 1:
+            raise ValueError(
+                "--input_service=on requires all workers on one host "
+                "(one shared-memory ring set per host); multi-host runs "
+                "start one service per host via their own local launch")
+        return True
+    return world > 1 and layout.num_hosts == 1
 
 
 class _ArrivalFetcher:
@@ -981,28 +1020,100 @@ def run_benchmark(
     phases = obs_goodput.PhaseTracker(obs_writer)
 
     # --- data ---
+    input_svc = None        # rank-0's InputService (stats + shutdown)
+    svc_client = None       # this worker's ring consumer
     if cfg.data_dir is not None and not spec.is_text:
         # real ImageNet TFRecords, per-host shard split (reference :19,80-81)
         from tpu_hc_bench.data.imagenet import ImageNetDataset
 
         image_size = spec.default_image_size
-        ds = ImageNetDataset(
-            cfg.data_dir,
-            global_batch=global_batch,
-            image_size=image_size,
-            split=data_split,
-            train=not cfg.eval,
-            worker=jax.process_index(),
-            num_workers=jax.process_count(),
-            seed=cfg.seed,
-            # uint8 ships 4x less host->device traffic; the cast+normalize
-            # runs inside the compiled step (train.step.prep_inputs)
-            wire_dtype=cfg.wire_dtype,
-            # 0 = auto-size the decode pool to the host's cores (the
-            # dataset normalizes 0/None = auto, 1 = serial)
-            decode_workers=cfg.datasets_num_private_threads,
-        )
-        host_iter = iter(ds)
+        if _input_service_on(cfg, layout):
+            # host-level shared input service (round 13): the lowest
+            # local rank owns ONE decode pool and feeds every local
+            # worker's shm ring; each worker's delivered stream is
+            # bitwise-identical to the per-process pipeline it replaces
+            from tpu_hc_bench.data import service as service_mod
+
+            world = jax.process_count()
+            ring_depth = max(2, cfg.prefetch_depth)
+            # every rank must derive the SAME name; a per-launch nonce
+            # broadcast from rank 0 keeps (a) a relaunch from attaching
+            # to a crashed run's stale segment before rank 0 reclaims
+            # it and (b) concurrent same-config runs on one host apart.
+            # Falls back to a config-only name if the collective is
+            # unavailable (then the config-hash + stale-reclaim in
+            # ShmRing.create is the only guard).
+            nonce = os.getpid()
+            if world > 1:
+                try:
+                    from jax.experimental import multihost_utils
+
+                    nonce = int(multihost_utils.broadcast_one_to_all(
+                        np.int64(os.getpid() * 1000
+                                 + (time.monotonic_ns() // 1000) % 1000)))
+                except Exception:
+                    nonce = 0
+            svc_name = service_mod.service_name(
+                cfg.data_dir, data_split, cfg.seed, global_batch,
+                image_size, cfg.wire_dtype, cfg.model,
+                cfg.metrics_dir or "", cfg.train_dir or "", nonce)
+            if jax.process_index() == 0:
+                input_svc = service_mod.make_image_service(
+                    [cfg.data_dir], num_workers=world,
+                    global_batch=global_batch, image_size=image_size,
+                    split=data_split, train=not cfg.eval, seed=cfg.seed,
+                    wire_dtype=cfg.wire_dtype,
+                    decode_workers=cfg.service_decode_workers,
+                    depth=ring_depth, name=svc_name,
+                ).start()
+                print_fn(
+                    f"input service: host decode pool "
+                    f"{input_svc.decode_workers} thread(s) serving "
+                    f"{world} worker(s) over shared-memory rings "
+                    f"(depth {ring_depth})")
+            # copy=True: the batch feeds an ASYNC jax.device_put (which
+            # on CPU may even alias the aligned buffer) while _prefetch
+            # pulls ahead — a zero-copy view's slot could be recycled
+            # mid-transfer, so the client takes an owned copy per batch
+            svc_client = service_mod.ServiceClient(
+                svc_name,
+                service_mod.image_batch_layout(global_batch, image_size,
+                                               cfg.wire_dtype),
+                worker=jax.process_index(), depth=ring_depth, copy=True,
+                # a dead service host must surface as an error, not an
+                # eternal data wait (10 min covers any sane decode)
+                stall_timeout_s=600.0)
+            ds = svc_client
+            host_iter = iter(svc_client)
+        else:
+            # ceil-divide on ragged layouts: over-dividing the pool is
+            # safe, while a fall-back to 1 would reinstate the full-
+            # width-per-process oversubscription this exists to fix
+            local_workers = -(-jax.process_count() // layout.num_hosts)
+            ds = ImageNetDataset(
+                cfg.data_dir,
+                global_batch=global_batch,
+                image_size=image_size,
+                split=data_split,
+                train=not cfg.eval,
+                worker=jax.process_index(),
+                num_workers=jax.process_count(),
+                seed=cfg.seed,
+                # uint8 ships 4x less host->device traffic; the
+                # cast+normalize runs inside the compiled step
+                # (train.step.prep_inputs)
+                wire_dtype=cfg.wire_dtype,
+                # 0 = auto-size the decode pool to this worker's SHARE
+                # of the host's cores (divided by local worker count —
+                # N private pools must not claim N*(cpu-1) threads)
+                decode_workers=cfg.datasets_num_private_threads,
+                local_workers=local_workers,
+                prefetch=cfg.prefetch_depth,
+            )
+            print_fn(f"decode pool: {ds.decode_workers} thread(s)/worker "
+                     f"({local_workers} local worker(s) share "
+                     f"{os.cpu_count()} host CPUs; per-process pipeline)")
+            host_iter = iter(ds)
         batch = next(host_iter)
 
         if cfg.datasets_repeat_cached_sample:
@@ -1826,9 +1937,15 @@ def run_benchmark(
                 if cfg.metrics_dir:
                     hb_step = timeline.fetcher.fetched_step
                     ewma_ms = hb_ewma.update(hb_step)
+                    # input-service backpressure rides the heartbeat:
+                    # ring occupancy now + consumer-wait delta this
+                    # window, so a starved host is visible fleet-wide
+                    hb_input = ({"input": svc_client.window_stats()}
+                                if svc_client is not None else {})
                     fleet_writer.heartbeat(
                         step=hb_step, step_ewma_ms=ewma_ms,
-                        mem=obs_metrics.device_memory_stats())
+                        mem=obs_metrics.device_memory_stats(),
+                        **hb_input)
                     if world > 1:
                         skew = obs_fleet.straggler_gather(hb_step, ewma_ms)
                         if skew is not None:
@@ -1938,6 +2055,13 @@ def run_benchmark(
         goodput_phases=({k: round(v, 3)
                          for k, v in ledger.seconds.items() if v > 0.0}
                         if ledger is not None else None),
+        data_wait_frac=(ledger.seconds.get("data_wait", 0.0)
+                        / ledger.wall_s
+                        if ledger is not None and ledger.wall_s > 0
+                        else float("nan")),
+        input_service=(svc_client is not None
+                       if cfg.data_dir is not None and not spec.is_text
+                       else None),
         mfu_source=mfu_rep["mfu_source"],
         resume=resume_rec,
     )
@@ -1973,6 +2097,15 @@ def run_benchmark(
         obs_writer.event("trace_buckets", **trace_rec)
     if hasattr(ds, "stats"):    # host decode-pool counters (real images)
         obs_writer.event("data", **ds.stats())
+    if input_svc is not None:
+        # host-level backpressure account (ring occupancy percentiles,
+        # producer stalls, consumer waits) — the `obs summarize` input
+        # line and `obs diff` delta row read this record
+        obs_writer.event("input_service", **input_svc.stats())
+    if svc_client is not None:
+        svc_client.close()
+    if input_svc is not None:
+        input_svc.stop()
     mem = obs_metrics.device_memory_stats()
     obs_writer.event("memory", supported=bool(mem), devices=mem)
     # gradient-allreduce wire bytes (the dominant collective): what the
