@@ -35,11 +35,27 @@ def main() -> int:
     from tpu_hc_bench.obs import metrics as obs_metrics
     from tpu_hc_bench.train import driver
 
-    cfg = flags.BenchmarkConfig(
+    # round 14: BENCH_CONFIG=auto resolves the tuned registry row for
+    # (BENCH_MODEL, live hardware) — tpu_hc_bench.tune.  The tuned
+    # batch only wins when no explicit BENCH_BATCH_SIZE is set (auto
+    # leaves the field at its dataclass default so resolve_auto's
+    # explicit-flag-wins rule lets the row through); manual keeps the
+    # headline protocol's batch 128.
+    config_mode = os.environ.get("BENCH_CONFIG", "manual")
+    batch_env = os.environ.get("BENCH_BATCH_SIZE")
+    if batch_env is not None:
+        batch_size = int(batch_env)
+    elif config_mode == "auto":
+        batch_size = flags.BenchmarkConfig.batch_size
+    else:
+        batch_size = 128
+
+    cfg_kwargs = dict(
         # full obs artifact (metrics.jsonl + manifest.json) when asked;
         # the manifest fields below ride in the JSON line regardless
         metrics_dir=os.environ.get("BENCH_METRICS_DIR") or None,
-        batch_size=int(os.environ.get("BENCH_BATCH_SIZE", "128")),
+        batch_size=batch_size,
+        config=config_mode,
         model=os.environ.get("BENCH_MODEL", "resnet50"),
         use_fp16=True,          # bf16 compute: the TPU-native fast path
         num_warmup_batches=int(os.environ.get("BENCH_WARMUP", "50")),
@@ -73,7 +89,21 @@ def main() -> int:
         # synthetic runs resolve the flag to off with a translation note
         data_dir=os.environ.get("BENCH_DATA_DIR") or None,
         input_service=os.environ.get("BENCH_INPUT_SERVICE", "auto"),
-    ).resolve()
+    )
+    cfg = flags.BenchmarkConfig(**cfg_kwargs).resolve()
+    if (config_mode == "auto" and cfg.config_source == "baseline"
+            and batch_env is None):
+        # no tuned row for this hardware: fall back to the HEADLINE
+        # protocol's batch 128, not the dataclass default 64 — a fresh
+        # machine's BENCH history must stay comparable with the manual
+        # runs.  Provenance stays 'baseline' and the loud note rides
+        # the translation banner either way.
+        note = cfg.translations.get("config")
+        cfg_kwargs.update(batch_size=128, config="manual")
+        cfg = flags.BenchmarkConfig(**cfg_kwargs).resolve()
+        cfg.config_source = "baseline"
+        if note:
+            cfg.translations["config"] = note
 
     # human-readable progress to stderr; stdout carries only the JSON line
     result = driver.run_benchmark(
@@ -133,6 +163,13 @@ def main() -> int:
             # a different experiment — obs diff and the BENCH history
             # must both see it as config drift, not a regression
             "resume": result.resume,
+            # config provenance (round 14): manual = hand-set flags,
+            # auto = a tuned registry row was applied (the row rides
+            # along), baseline = --config=auto found no row and fell
+            # back to BASELINE defaults — the perf trajectory must
+            # distinguish tuned from hand-set runs
+            "config_source": cfg.config_source,
+            "tuned_config": cfg.tuned_config,
         },
         "manifest": {
             k: manifest.get(k)

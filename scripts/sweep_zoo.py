@@ -3,128 +3,31 @@
 The reference records one number per (model, batch, fabric) run in a tee'd
 log (run-tf-sing-ucx-openmpi.sh:9-12); this sweep automates the matrix the
 way an operator would drive it, writing ``sweep_results.jsonl`` for
-BASELINE.md.  Usage:
+BASELINE.md.
+
+The matrix itself (the best-known per-member configs that used to live
+here as ``DEFAULT_MATRIX``/``EXTRA_FLAGS``) now lives in
+``tpu_hc_bench.tune.space.SEED_CONFIGS`` — one copy shared by this
+sweep, the autotuner's search space, and the pruner's HBM model — and
+the subprocess launch/timeout/exit-contract/parse logic is
+``tpu_hc_bench.tune.runner.run_one``, shared with the successive-halving
+search.  Usage:
 
     python scripts/sweep_zoo.py [--out FILE] [--models a,b,c]
+
+    # re-validate the tuned registry rows for this hardware instead of
+    # the seeded matrix (the autotuner's regression loop)
+    python scripts/sweep_zoo.py --from_registry [--hardware KEY]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import subprocess
 import sys
-import time
+from pathlib import Path
 
-# (model, per-chip batch) — each entry is the member's BEST-KNOWN config
-# (BASELINE.md zoo table) and is only valid TOGETHER with its EXTRA_FLAGS
-# entry below: the accumulation members' batches exceed HBM as plain
-# one-shot batches and fit only as accum microbatches.  Members without
-# an EXTRA_FLAGS entry run plain batches chosen to fill HBM without OOM,
-# mirroring tf_cnn_benchmarks' per-model defaults where it has them.
-DEFAULT_MATRIX = [
-    ("trivial", 512),
-    ("lenet", 2048),
-    ("alexnet", 2048),
-    ("overfeat", 4096),
-    ("googlenet", 256),
-    ("mobilenet", 256),
-    ("nasnet", 128),
-    ("nasnetlarge", 128),
-    ("densenet40_k12", 512),
-    ("densenet100_k12", 256),
-    ("resnet18", 256),
-    ("resnet34", 256),
-    ("resnet50", 128),
-    ("resnet101", 512),
-    ("resnet152", 512),
-    ("resnet50_v2", 1024),
-    ("resnet101_v2", 512),
-    ("resnet152_v2", 512),
-    ("resnet20_cifar", 1024),
-    ("resnet56_cifar", 512),
-    ("resnet110_cifar", 256),
-    ("vgg11", 1024),
-    ("vgg16", 1024),
-    ("vgg19", 1024),
-    ("inception3", 128),
-    ("vit_b16", 256),
-    ("vit_l16", 512),
-    ("inception4", 512),
-    ("bert_base", 1024),
-    ("bert_large", 1024),
-    ("gpt2", 128),
-    ("gpt2_medium", 64),
-    # round 5: the bf16 accumulator unlocked batch scaling past the
-    # bs=16 OOM wall (microbatch 8; BASELINE.md round 5) — +37%
-    ("gpt2_moe", 512),
-    ("llama_1b", 2),
-    # zoo completed round 3 (tf_cnn's last two members)
-    # round 4: both members' old tf_cnn-default batches starved the chip
-    # (ds2 bs=16 ran the recurrence at M=16; see BASELINE.md "the plain
-    # batch-size levers") — these are the measured TPU operating points
-    ("ncf", 1048576),
-    ("deepspeech2", 256),
-]
-
-# per-model extra flags (best-known single-chip configs, BASELINE.md)
-EXTRA_FLAGS = {
-    "gpt2": ["--attention_impl=flash", "--gradient_accumulation_steps=8"],
-    "gpt2_medium": ["--attention_impl=flash",
-                    "--gradient_accumulation_steps=16"],
-    "gpt2_moe": ["--attention_impl=flash",
-                 "--gradient_accumulation_steps=64", "--accum_dtype=bf16"],
-    "llama_1b": ["--attention_impl=flash"],
-    "bert_base": ["--gradient_accumulation_steps=8"],
-    "bert_large": ["--gradient_accumulation_steps=32"],
-    "vit_b16": ["--gradient_accumulation_steps=4"],
-    "vit_l16": ["--gradient_accumulation_steps=8"],
-    "vgg16": ["--gradient_accumulation_steps=8"],
-    "vgg11": ["--gradient_accumulation_steps=8"],
-    "inception4": ["--gradient_accumulation_steps=8"],
-    "resnet101": ["--gradient_accumulation_steps=8"],
-    "resnet152": ["--gradient_accumulation_steps=8"],
-    "resnet50_v2": ["--gradient_accumulation_steps=8"],
-    "resnet101_v2": ["--gradient_accumulation_steps=8"],
-    "resnet152_v2": ["--gradient_accumulation_steps=8"],
-    "nasnetlarge": ["--gradient_accumulation_steps=8"],
-    # round 5: the big-FC conv members amortize optimizer traffic too
-    "alexnet": ["--gradient_accumulation_steps=4"],
-    "overfeat": ["--gradient_accumulation_steps=16"],
-    "vgg19": ["--gradient_accumulation_steps=8"],
-}
-
-
-def run_one(model: str, batch: int, warmup: int, batches: int) -> dict:
-    cmd = [
-        sys.executable, "-m", "tpu_hc_bench", "1", "0", str(batch), "ici",
-        f"--model={model}", "--use_fp16=True",
-        f"--num_warmup_batches={warmup}", f"--num_batches={batches}",
-        *EXTRA_FLAGS.get(model, []),
-    ]
-    t0 = time.time()
-    rec: dict = {"model": model, "batch_size": batch}
-    if EXTRA_FLAGS.get(model):
-        rec["flags"] = EXTRA_FLAGS[model]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=1800)
-    except subprocess.TimeoutExpired:
-        rec.update(wall_s=round(time.time() - t0, 1), error="timeout")
-        return rec
-    out = proc.stdout + proc.stderr
-    rec["wall_s"] = round(time.time() - t0, 1)
-    if proc.returncode != 0:
-        rec["error"] = out.strip().splitlines()[-1] if out.strip() else "?"
-        return rec
-    for line in out.splitlines():
-        if line.startswith("images/sec/chip:") or "examples/sec/chip" in line:
-            # "images/sec/chip: X  step: Yms (p50 Zms)  MFU: W%"
-            parts = line.replace("%", "").split()
-            rec["per_chip"] = float(parts[1])
-            rec["step_ms"] = float(parts[3].rstrip("ms"))
-            rec["mfu_pct"] = float(parts[-1])
-    return rec
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 
 def main() -> None:
@@ -134,16 +37,61 @@ def main() -> None:
                     help="comma list; default = full matrix")
     ap.add_argument("--warmup", type=int, default=25)
     ap.add_argument("--batches", type=int, default=60)
+    ap.add_argument("--from_registry", action="store_true",
+                    help="sweep the tuned-config registry rows for this "
+                         "hardware (tpu_hc_bench.tune) instead of the "
+                         "seeded best-known matrix")
+    ap.add_argument("--hardware", default=None,
+                    help="registry hardware key (default: the live "
+                         "backend's, honoring TPU_HC_TUNE_HW)")
     args = ap.parse_args()
 
-    matrix = DEFAULT_MATRIX
-    if args.models:
-        wanted = set(args.models.split(","))
-        matrix = [(m, b) for m, b in DEFAULT_MATRIX if m in wanted]
+    from tpu_hc_bench.tune import registry as registry_mod
+    from tpu_hc_bench.tune import runner as runner_mod
+    from tpu_hc_bench.tune import space as space_mod
+
+    wanted = set(args.models.split(",")) if args.models else None
+
+    # (model, batch, extra flags, provenance) rows to run
+    if args.from_registry:
+        hardware = args.hardware or registry_mod.hardware_key()
+        rows = registry_mod.load_rows(hardware)
+        if not rows:
+            print(f"no tuned rows for hardware {hardware!r} "
+                  f"({registry_mod.registry_path(hardware)}) — run "
+                  f"`python -m tpu_hc_bench.tune search` first",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        matrix = []
+        for model in sorted(rows):
+            if wanted is not None and model not in wanted:
+                continue
+            try:
+                c = space_mod.Candidate.make(
+                    model, dict(rows[model]["overrides"]),
+                    dict(rows[model].get("base") or {}))
+            except ValueError as e:
+                # one stale row (lever renamed since the search) must
+                # not block re-validating every other member; the
+                # tuned-config-staleness lint is the loud gate
+                print(f"skipping {model}: {e} (stale registry row?)",
+                      file=sys.stderr)
+                continue
+            matrix.append((model, c.batch_size, c.to_flags(), "registry"))
+    else:
+        matrix = []
+        for model, batch in space_mod.seed_matrix():
+            if wanted is not None and model not in wanted:
+                continue
+            matrix.append((model, batch,
+                           space_mod.seed_extra_flags(model), "seed"))
 
     with open(args.out, "a") as f:
-        for model, batch in matrix:
-            rec = run_one(model, batch, args.warmup, args.batches)
+        for model, batch, flags, source in matrix:
+            rec = runner_mod.run_one(model, batch, flags,
+                                     warmup=args.warmup,
+                                     batches=args.batches)
+            rec["config_source"] = source
             f.write(json.dumps(rec) + "\n")
             f.flush()
             print(json.dumps(rec), flush=True)

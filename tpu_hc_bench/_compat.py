@@ -62,6 +62,13 @@ CAPABILITIES = {
     # jaxlib corrupts the heap (glibc "corrupted double-linked list"
     # abort) — tests/conftest.py gates the compile cache on this
     "persistent_compilation_cache": _JAX_VERSION >= (0, 5),
+    # jax.make_array_from_process_local_data: each process contributes
+    # ONLY its local batch rows and jax assembles the global array — the
+    # driver's sliced input mode (round 14).  hasattr, not a version
+    # compare: the API landed mid-0.4.x and a backport shim would be
+    # worse than the full-batch fallback the driver keeps
+    # (--full_batch_identity)
+    "process_local_arrays": hasattr(jax, "make_array_from_process_local_data"),
 }
 
 

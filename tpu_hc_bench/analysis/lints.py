@@ -61,7 +61,7 @@ from tpu_hc_bench.analysis.report import Finding
 
 __all__ = [
     "lint_source_text", "lint_file", "lint_repo_sources", "lint_model",
-    "check_zero1_collectives", "ALL_SOURCE_LINTS",
+    "check_zero1_collectives", "check_tuned_registry", "ALL_SOURCE_LINTS",
 ]
 
 HOST_SYNC = "host-sync-in-jit"
@@ -71,6 +71,7 @@ SHARDING = "sharding-consistency"
 COLLECTIVE_SHAPE = "collective-shape"
 CKPT_TOPOLOGY = "checkpoint-topology"
 INPUT_POOL = "input-pool-width"
+TUNED_STALENESS = "tuned-config-staleness"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
                     INPUT_POOL)
 
@@ -586,7 +587,8 @@ def lint_file(path: str | Path, model: str = "repo") -> list[Finding]:
 
 
 def lint_repo_sources(root: str | Path | None = None) -> list[Finding]:
-    """AST passes over every package + scripts source file."""
+    """AST passes over every package + scripts source file, plus the
+    tuned-config registry staleness check over ``artifacts/tuned/``."""
     if root is None:
         root = Path(__file__).resolve().parents[2]
     root = Path(root)
@@ -601,6 +603,59 @@ def lint_repo_sources(root: str | Path | None = None) -> list[Finding]:
             except ValueError:
                 rel = str(path)
             findings.extend(lint_source_text(path.read_text(), rel))
+    findings.extend(check_tuned_registry(root / "artifacts" / "tuned"))
+    return findings
+
+
+def check_tuned_registry(
+        registry_dir: str | Path | None = None) -> list[Finding]:
+    """**tuned-config-staleness** (warning): a tuned-config registry row
+    (``artifacts/tuned/<hardware_key>.json``, ``tpu_hc_bench.tune``)
+    whose recorded flag names no longer exist on ``BenchmarkConfig``.
+
+    ``--config=auto`` deliberately survives a stale row (it skips the
+    unknown flag with a banner note rather than crash every run —
+    ``tune.registry.resolve_auto``), so THIS is the loud gate that
+    protects the registry across flag refactors: rename a lever and CI
+    points at every registry row still spelling the old name.  An
+    unreadable registry file flags too — a truncated write would
+    otherwise silently disable tuning for that hardware.
+    """
+    import dataclasses
+    import json
+
+    from tpu_hc_bench.flags import BenchmarkConfig
+
+    if registry_dir is None:
+        from tpu_hc_bench.tune.registry import default_registry_dir
+
+        registry_dir = default_registry_dir()
+    base = Path(registry_dir)
+    findings: list[Finding] = []
+    if not base.is_dir():
+        return findings
+    fields = {f.name for f in dataclasses.fields(BenchmarkConfig)}
+    for path in sorted(base.glob("*.json")):
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            findings.append(Finding(
+                TUNED_STALENESS, "warning", "repo",
+                f"artifacts/tuned/{path.name}",
+                f"unreadable registry file: {e}"))
+            continue
+        for model, row in sorted((data.get("members") or {}).items()):
+            recorded = {**(row.get("base") or {}),
+                        **(row.get("overrides") or {})}
+            for k in sorted(recorded):
+                if k not in fields:
+                    findings.append(Finding(
+                        TUNED_STALENESS, "warning", model,
+                        f"artifacts/tuned/{path.name}:{model}/{k}",
+                        f"tuned row records flag {k!r}, which is no "
+                        f"longer a BenchmarkConfig field — re-run "
+                        f"`python -m tpu_hc_bench.tune search` or edit "
+                        f"the row"))
     return findings
 
 

@@ -331,6 +331,37 @@ class BenchmarkConfig:
                                               # (0 = auto: cpu_count-1 for
                                               # the whole host)
 
+    # --- autotuner (round 14) ---
+    config: str = "manual"                    # manual: flags mean what
+                                              # they say (the reference
+                                              # contract); auto: resolve()
+                                              # loads this member's tuned
+                                              # row from the registry
+                                              # (artifacts/tuned/
+                                              # <hardware_key>.json,
+                                              # tpu_hc_bench.tune) and
+                                              # applies its lever
+                                              # overrides to every field
+                                              # left at the default —
+                                              # explicit flags win; no
+                                              # row falls back LOUDLY to
+                                              # BASELINE defaults
+    full_batch_identity: bool = False         # multi-worker input: ship
+                                              # each process the FULL
+                                              # global batch and let
+                                              # device_put keep the local
+                                              # slice (the conservative
+                                              # pre-round-14 arm, kept
+                                              # for the bitwise A/B).
+                                              # Default off: each process
+                                              # builds the global array
+                                              # from its LOCAL rows
+                                              # (jax.make_array_from_
+                                              # process_local_data) and
+                                              # the input service serves
+                                              # sliced rings — the W-fold
+                                              # host-decode saving
+
     # --- resilience (round 8; no reference analog — SURVEY.md §5 notes
     # the reference just dies) ---
     on_nonfinite: str = "abort"               # non-finite loss/grad-norm
@@ -375,6 +406,19 @@ class BenchmarkConfig:
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
+    # config provenance (resolve()): manual = hand-set flags, auto = a
+    # tuned registry row was applied, baseline = --config=auto found no
+    # row and fell back to the BASELINE defaults.  BENCH json and the
+    # run manifest carry both fields so the perf trajectory can
+    # distinguish tuned from hand-set runs.
+    config_source: str = "manual"
+    tuned_config: dict | None = None
+    # Populated by parse_flags(): the flag names the operator actually
+    # typed (the launcher's positional batch included).  --config=auto
+    # consults this so an EXPLICIT --batch_size=64 pins the default
+    # value against the tuned row; programmatic configs leave it None
+    # and resolve_auto falls back to "non-default means set".
+    explicit_flags: tuple | None = None
 
     @property
     def compute_dtype(self) -> str:
@@ -388,6 +432,20 @@ class BenchmarkConfig:
         *semantics*, not literal values that would be wrong on TPU.
         """
         t: dict[str, str] = {}
+        if self.config not in ("manual", "auto"):
+            raise ValueError(
+                f"--config must be manual|auto: {self.config!r}")
+        if self.config == "auto":
+            # the one deliberate exception to resolve()'s filesystem-
+            # purity principle (--fabric_ceiling/--compile_cache defer
+            # their reads to the driver): --config=auto IS a registry
+            # read, and it must happen before the validations below so
+            # an applied tuned row is checked like any hand-set flag.
+            # Registry dir / hardware key honor TPU_HC_TUNE_REGISTRY /
+            # TPU_HC_TUNE_HW env overrides (tune.registry).
+            from tpu_hc_bench.tune import registry as tune_registry
+
+            t["config"] = tune_registry.resolve_auto(self)
         if self.data_format.upper() == "NCHW":
             t["data_format"] = "NCHW->NHWC (MXU wants channels-minor)"
             self.data_format = "NHWC"
@@ -838,6 +896,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["on", "off", "auto"])
     p.add_argument("--service_decode_workers", type=int,
                    default=d.service_decode_workers)
+    p.add_argument("--config", type=str, default=d.config,
+                   choices=["manual", "auto"])
+    p.add_argument("--full_batch_identity", type=_parse_bool,
+                   default=d.full_batch_identity)
     p.add_argument("--on_nonfinite", type=str, default=d.on_nonfinite,
                    choices=["abort", "skip", "rewind"])
     p.add_argument("--max_bad_steps", type=int, default=d.max_bad_steps)
@@ -896,10 +958,21 @@ def build_parser() -> argparse.ArgumentParser:
 
 def parse_flags(argv: Sequence[str] | None = None) -> BenchmarkConfig:
     """Parse a tf_cnn_benchmarks-style argv into a resolved BenchmarkConfig."""
+    if argv is None:
+        import sys
+
+        argv = sys.argv[1:]
     ns = build_parser().parse_args(argv)
     fields = {f.name for f in dataclasses.fields(BenchmarkConfig)}
     kwargs: dict[str, Any] = {
         k: v for k, v in vars(ns).items() if k in fields
     }
     kwargs["data_format"] = kwargs["data_format"].upper()
-    return BenchmarkConfig(**kwargs).resolve()
+    cfg = BenchmarkConfig(**kwargs)
+    # record what the operator actually typed BEFORE resolve():
+    # --config=auto must honor an explicit flag even when its value
+    # equals the dataclass default
+    cfg.explicit_flags = tuple(sorted(
+        {a[2:].split("=", 1)[0] for a in argv if a.startswith("--")}
+        & fields))
+    return cfg.resolve()

@@ -1027,6 +1027,36 @@ def run_benchmark(
         from tpu_hc_bench.data.imagenet import ImageNetDataset
 
         image_size = spec.default_image_size
+        # round 14: sliced input — each worker decodes and ships ONLY
+        # its own rows of the global batch (the service rings carry the
+        # slice, the per-process pipeline decodes just the consumed
+        # rows), and jax.make_array_from_process_local_data assembles
+        # the global array.  The pre-round-14 arm (every process builds
+        # the FULL global batch, device_put keeps the local slice) is
+        # the bitwise A/B control, kept as --full_batch_identity and as
+        # the fallback on stacks without the API.  Delivered pixels are
+        # identical either way (per-row RNG keying); only the W-fold
+        # redundant host decode/copy disappears.
+        in_world = jax.process_count()
+        sliced_input = False
+        if in_world > 1 and not cfg.full_batch_identity:
+            from tpu_hc_bench._compat import CAPABILITIES
+
+            if not CAPABILITIES["process_local_arrays"]:
+                print_fn("sliced input: this jax lacks "
+                         "make_array_from_process_local_data — "
+                         "full-batch identity fallback")
+            elif global_batch % in_world:
+                print_fn(f"sliced input: global batch {global_batch} "
+                         f"not divisible by {in_world} worker(s) — "
+                         "full-batch identity fallback")
+            else:
+                sliced_input = True
+        _rows = None
+        if sliced_input:
+            per_w = global_batch // in_world
+            _rows = (jax.process_index() * per_w,
+                     (jax.process_index() + 1) * per_w)
         if _input_service_on(cfg, layout):
             # host-level shared input service (round 13): the lowest
             # local rank owns ONE decode pool and feeds every local
@@ -1056,7 +1086,8 @@ def run_benchmark(
             svc_name = service_mod.service_name(
                 cfg.data_dir, data_split, cfg.seed, global_batch,
                 image_size, cfg.wire_dtype, cfg.model,
-                cfg.metrics_dir or "", cfg.train_dir or "", nonce)
+                cfg.metrics_dir or "", cfg.train_dir or "",
+                "sliced" if sliced_input else "full", nonce)
             if jax.process_index() == 0:
                 input_svc = service_mod.make_image_service(
                     [cfg.data_dir], num_workers=world,
@@ -1065,20 +1096,25 @@ def run_benchmark(
                     wire_dtype=cfg.wire_dtype,
                     decode_workers=cfg.service_decode_workers,
                     depth=ring_depth, name=svc_name,
+                    slice_per_worker=sliced_input,
                 ).start()
                 print_fn(
                     f"input service: host decode pool "
                     f"{input_svc.decode_workers} thread(s) serving "
                     f"{world} worker(s) over shared-memory rings "
-                    f"(depth {ring_depth})")
+                    f"(depth {ring_depth}"
+                    + (", sliced rings: each worker's ring carries "
+                       f"only its {global_batch // world} rows"
+                       if sliced_input else "") + ")")
             # copy=True: the batch feeds an ASYNC jax.device_put (which
             # on CPU may even alias the aligned buffer) while _prefetch
             # pulls ahead — a zero-copy view's slot could be recycled
             # mid-transfer, so the client takes an owned copy per batch
             svc_client = service_mod.ServiceClient(
                 svc_name,
-                service_mod.image_batch_layout(global_batch, image_size,
-                                               cfg.wire_dtype),
+                service_mod.image_batch_layout(
+                    global_batch // world if sliced_input else global_batch,
+                    image_size, cfg.wire_dtype),
                 worker=jax.process_index(), depth=ring_depth, copy=True,
                 # a dead service host must surface as an error, not an
                 # eternal data wait (10 min covers any sane decode)
@@ -1109,12 +1145,37 @@ def run_benchmark(
                 decode_workers=cfg.datasets_num_private_threads,
                 local_workers=local_workers,
                 prefetch=cfg.prefetch_depth,
+                # sliced mode: decode only the rows this process's
+                # devices hold; the per-row RNG still advances over all
+                # rows, so the delivered pixels are bitwise-identical
+                # to the full pipeline's same rows
+                decode_rows=_rows,
             )
             print_fn(f"decode pool: {ds.decode_workers} thread(s)/worker "
                      f"({local_workers} local worker(s) share "
-                     f"{os.cpu_count()} host CPUs; per-process pipeline)")
+                     f"{os.cpu_count()} host CPUs; per-process pipeline"
+                     + (f"; sliced: decoding rows [{_rows[0]}, {_rows[1]})"
+                        if _rows is not None else "") + ")")
             host_iter = iter(ds)
+            if sliced_input:
+                # decode_rows yields full-shaped batches with only the
+                # local rows decoded — hand downstream just the rows.
+                # close() must reach the dataset iterator (the
+                # repeat_cached path stops the decode pool through it)
+                def _local_rows(it, lo=_rows[0], hi=_rows[1]):
+                    try:
+                        for b in it:
+                            yield tuple(a[lo:hi] for a in b)
+                    finally:
+                        it.close()
+                host_iter = _local_rows(host_iter)
         batch = next(host_iter)
+        # sliced mode ships local rows through make_array_from_process_
+        # local_data; the identity arm ships the global batch through
+        # device_put (which keeps the local slice)
+        place_batch = (
+            (lambda b: step_mod.shard_batch_local(b, mesh)) if sliced_input
+            else (lambda b: step_mod.shard_batch(b, mesh)))
 
         if cfg.datasets_repeat_cached_sample:
             # --datasets_repeat_cached_sample: decode a handful of REAL
@@ -1131,7 +1192,7 @@ def run_benchmark(
             import itertools
 
             cached = [
-                step_mod.shard_batch(b, mesh)
+                place_batch(b)
                 for b in itertools.chain(
                     [batch], itertools.islice(host_iter, 7))
             ]
@@ -1150,7 +1211,7 @@ def run_benchmark(
                     import itertools
 
                     for b in itertools.chain([batch], host_iter):
-                        yield step_mod.shard_batch(b, mesh)
+                        yield place_batch(b)
                 yield from _prefetch(raw(), cfg.prefetch_depth)
     elif spec.is_text and cfg.data_dir is not None:
         # real pre-tokenized corpus (<data_dir>/<split>.bin memmap) — the

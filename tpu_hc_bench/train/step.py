@@ -1105,3 +1105,28 @@ def shard_batch(batch: tuple, mesh: Mesh, spec: P | None = None) -> tuple:
                 if DCN_AXIS in mesh.axis_names else P(DATA_AXIS))
     sharding = NamedSharding(mesh, spec)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def shard_batch_local(batch: tuple, mesh: Mesh,
+                      spec: P | None = None) -> tuple:
+    """Place a batch from per-process LOCAL rows (round 14).
+
+    ``shard_batch`` takes the full global batch from every process and
+    lets ``device_put`` keep the local slice — bitwise-safe but W-fold
+    redundant on the host (each worker decodes/ships rows its devices
+    never hold).  Here each process passes only its own rows and
+    ``jax.make_array_from_process_local_data`` assembles the global
+    array.  At world=1 the two are identical (the local rows ARE the
+    global batch).  Callers gate on
+    ``_compat.CAPABILITIES["process_local_arrays"]`` and fall back to
+    ``shard_batch`` (the driver's ``--full_batch_identity`` arm).
+    """
+    from tpu_hc_bench.topology import DCN_AXIS
+
+    if spec is None:
+        spec = (P((DCN_AXIS, DATA_AXIS))
+                if DCN_AXIS in mesh.axis_names else P(DATA_AXIS))
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        batch)

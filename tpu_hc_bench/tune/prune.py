@@ -1,0 +1,167 @@
+"""Static pruning: reject candidates before paying for a run.
+
+Three free (or near-free) rejection classes, each recorded in the
+search journal with its class tag so the ledger shows what the pruner
+bought:
+
+- ``flag-invalid`` — ``BenchmarkConfig.resolve()`` raises at flag time
+  (the zero1 composition matrix, accum on the GSPMD arms, dtype lever
+  without accumulation...).  The flag surface already encodes years of
+  "died 50 warmup steps in" lessons; the pruner gets them for free.
+- ``lint`` — per-member ``analysis`` findings (host-sync-in-jit,
+  recompile hazards, sharding inconsistencies) not accepted by the
+  checked-in baseline.  Evaluated once per member and cached — a member
+  whose step program is statically broken skips its whole candidate
+  class.
+- ``hbm-oom`` — a small HBM occupancy model seeded from the best-known
+  configs (``tune.space.SEED_CONFIGS``, the machine form of the
+  BASELINE zoo table): the seeded (batch, accum) pairing is the
+  measured operating point near the HBM ceiling, so a candidate whose
+  *microbatch* (batch / accum — the activation-memory unit the chip
+  actually holds) exceeds that anchor by more than ``headroom`` is a
+  known-OOM skip, and a member whose seed NEEDED the bf16 accumulator
+  rejects f32-accumulator candidates at or above the seeded batch (the
+  f32 grad tree is the thing that OOMed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable
+
+from tpu_hc_bench.tune.space import Candidate, SEED_CONFIGS, seed_candidate
+
+__all__ = ["Skip", "PruneResult", "HbmModel", "static_prune",
+           "baseline_lint_classes"]
+
+FLAG_INVALID = "flag-invalid"
+LINT = "lint"
+HBM_OOM = "hbm-oom"
+
+
+@dataclasses.dataclass(frozen=True)
+class Skip:
+    candidate: Candidate
+    cls: str        # flag-invalid | lint | hbm-oom
+    reason: str
+
+    def journal_record(self) -> dict:
+        return {"key": self.candidate.key, "class": self.cls,
+                "reason": self.reason}
+
+
+@dataclasses.dataclass
+class PruneResult:
+    survivors: list[Candidate]
+    skipped: list[Skip]
+
+    @property
+    def skipped_classes(self) -> set[str]:
+        return {s.cls for s in self.skipped}
+
+
+@dataclasses.dataclass(frozen=True)
+class HbmModel:
+    """Known-OOM rejection seeded from a member's best-known config.
+
+    ``max_microbatch`` is the seeded batch/accum — the measured
+    activation-memory operating point; ``needs_bf16_accum_at`` is the
+    seeded batch when the seed carries ``accum_dtype=bf16`` (meaning
+    the f32 accumulator tree is what OOMed there, BASELINE.md round 5).
+    """
+
+    max_microbatch: int
+    headroom: float = 2.0
+    needs_bf16_accum_at: int | None = None
+
+    @staticmethod
+    def seeded(model: str, headroom: float = 2.0) -> "HbmModel | None":
+        if model not in SEED_CONFIGS:
+            return None
+        seed = seed_candidate(model)
+        d = dict(seed.overrides)
+        batch = int(d["batch_size"])
+        accum = int(d.get("gradient_accumulation_steps", 1))
+        bf16_at = (batch if d.get("accum_dtype") == "bf16" else None)
+        return HbmModel(max_microbatch=max(1, batch // accum),
+                        headroom=headroom,
+                        needs_bf16_accum_at=bf16_at)
+
+    def check(self, c: Candidate) -> str | None:
+        """A rejection reason, or None when the candidate plausibly
+        fits."""
+        d = dict(c.overrides)
+        batch = int(d.get("batch_size", 0)) or c.batch_size
+        accum = int(d.get("gradient_accumulation_steps", 1))
+        micro = max(1, batch // max(1, accum))
+        limit = int(self.max_microbatch * self.headroom)
+        if micro > limit:
+            return (f"microbatch {micro} (batch {batch} / accum {accum}) "
+                    f"exceeds the seeded HBM anchor {self.max_microbatch} "
+                    f"x headroom {self.headroom:g} = {limit}")
+        if (self.needs_bf16_accum_at is not None
+                and accum > 1
+                and d.get("accum_dtype", "f32") == "f32"
+                and batch >= self.needs_bf16_accum_at):
+            return (f"f32 accumulator tree at batch {batch}: the seeded "
+                    f"config needed accum_dtype=bf16 at batch "
+                    f"{self.needs_bf16_accum_at} (f32 tree OOMs)")
+        return None
+
+
+@functools.lru_cache(maxsize=None)
+def baseline_lint_classes(model: str) -> tuple[str, ...]:
+    """Member-level lint regressions (findings the checked-in baseline
+    does not accept) — evaluated once per member, cached.  This is the
+    expensive pruner pass (it traces the model's jaxpr), so the search
+    calls it through this cache and the stubbed tests inject their own
+    ``lint_fn``."""
+    from tpu_hc_bench.analysis import compare_to_baseline
+    from tpu_hc_bench.analysis.lints import lint_model
+
+    try:
+        regressions = compare_to_baseline(lint_model(model))
+    except Exception as e:        # a model that fails to trace is a skip
+        return (f"lint pass failed to trace {model}: {e}",)
+    return tuple(f.render() for f in regressions)
+
+
+def static_prune(
+    candidates: list[Candidate],
+    hbm: HbmModel | None = None,
+    lint_fn: Callable[[str], tuple[str, ...]] | None = None,
+) -> PruneResult:
+    """Partition candidates into survivors and classed skips.
+
+    ``hbm=None`` seeds the model from the member's best-known config
+    (no-op for members outside the seed table).  ``lint_fn`` maps a
+    member name to lint-regression reasons (default: none — the CLI
+    passes ``baseline_lint_classes``; tests inject stubs).
+    """
+    survivors: list[Candidate] = []
+    skipped: list[Skip] = []
+    hbm_by_model: dict[str, HbmModel | None] = {}
+    lint_by_model: dict[str, tuple[str, ...]] = {}
+    for c in candidates:
+        if c.model not in lint_by_model:
+            lint_by_model[c.model] = lint_fn(c.model) if lint_fn else ()
+        reasons = lint_by_model[c.model]
+        if reasons:
+            skipped.append(Skip(c, LINT, "; ".join(reasons)))
+            continue
+        try:
+            c.to_config().resolve()
+        except ValueError as e:
+            skipped.append(Skip(c, FLAG_INVALID, str(e)))
+            continue
+        if c.model not in hbm_by_model:
+            hbm_by_model[c.model] = (hbm if hbm is not None
+                                     else HbmModel.seeded(c.model))
+        model_hbm = hbm_by_model[c.model]
+        reason = model_hbm.check(c) if model_hbm is not None else None
+        if reason:
+            skipped.append(Skip(c, HBM_OOM, reason))
+            continue
+        survivors.append(c)
+    return PruneResult(survivors=survivors, skipped=skipped)

@@ -1,0 +1,168 @@
+"""The ONE subprocess runner behind the sweep and the tuner.
+
+``scripts/sweep_zoo.py`` and the successive-halving search both need
+the same thing: launch ``python -m tpu_hc_bench 1 0 <batch> ici
+--model=<m> <flags...>`` in a subprocess, enforce a timeout, classify
+the launcher's exit-code contract (0 ok / 1 zero-throughput / 70
+watchdog / 75 preempted — ``tpu_hc_bench.resilience``), and parse one
+result record.  Two diverging copies of that logic is how the old
+regex miscounting bugs happened (ADVICE.md round 5), so it lives here
+once.
+
+Result parsing prefers the machine-readable path: with ``metrics_dir``
+set, the run's ``metrics.jsonl`` final ``summary`` record (the
+BenchmarkResult fields as one JSON line, goodput included) is the
+source of truth; the stdout ``images/sec/chip:`` line is the fallback
+for runs without a metrics artifact.
+
+The *score* the search ranks by is goodput-adjusted throughput:
+``images_per_sec_per_chip x goodput`` — a config that wins on raw
+step rate but spends its wall recompiling or blocked on input loses to
+one that keeps the chip productive.  Runs without a ledger (NaN
+goodput) fall back to the raw per-chip rate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+__all__ = ["run_one", "score", "parse_stdout_metrics", "EXIT_CLASSES"]
+
+# launcher exit-code contract (README "Fault tolerance" table)
+EXIT_CLASSES = {
+    0: None,
+    1: "zero-throughput",
+    70: "watchdog-timeout",
+    75: "preempted",
+}
+
+
+def parse_stdout_metrics(out: str) -> dict:
+    """The legacy stdout parse: ``images/sec/chip: X  step: Yms
+    (p50 ...)  MFU: W%`` (also the ``examples/sec/chip`` spelling)."""
+    rec: dict = {}
+    for line in out.splitlines():
+        if line.startswith("images/sec/chip:") or "examples/sec/chip" in line:
+            parts = line.replace("%", "").split()
+            try:
+                rec["per_chip"] = float(parts[1])
+                rec["step_ms"] = float(parts[3].rstrip("ms"))
+                rec["mfu_pct"] = float(parts[-2] if parts[-1].startswith("(")
+                                       else parts[-1])
+            except (IndexError, ValueError):
+                pass
+    return rec
+
+
+def _read_summary(metrics_dir: str) -> dict | None:
+    """The final ``summary`` record of the run's metrics.jsonl (None
+    when the stream is missing or carries no summary)."""
+    path = os.path.join(metrics_dir, "metrics.jsonl")
+    try:
+        summary = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("kind") == "summary":
+                    summary = rec
+        return summary
+    except OSError:
+        return None
+
+
+def run_one(
+    model: str,
+    batch: int,
+    flags: list[str] | None = None,
+    *,
+    warmup: int = 25,
+    batches: int = 60,
+    timeout_s: float = 1800.0,
+    metrics_dir: str | None = None,
+    use_fp16: bool = True,
+    env: dict | None = None,
+    cwd: str | None = None,
+) -> dict:
+    """Run one member config in a subprocess; return one JSON-able
+    record (the sweep's jsonl line shape, extended).
+
+    Never raises on a failed run: timeouts, nonzero exits, and
+    unparseable output all come back as a record with ``error`` set —
+    the search treats those as score-0 candidates, the sweep writes
+    them to the jsonl as-is.
+    """
+    flags = list(flags or [])
+    if metrics_dir is not None:
+        os.makedirs(metrics_dir, exist_ok=True)
+        flags.append(f"--metrics_dir={metrics_dir}")
+    cmd = [
+        sys.executable, "-m", "tpu_hc_bench", "1", "0", str(batch), "ici",
+        f"--model={model}",
+        f"--num_warmup_batches={warmup}", f"--num_batches={batches}",
+    ]
+    if use_fp16:
+        cmd.append("--use_fp16=True")
+    cmd.extend(flags)
+
+    rec: dict = {"model": model, "batch_size": batch}
+    if flags:
+        rec["flags"] = flags
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env, cwd=cwd)
+    except subprocess.TimeoutExpired:
+        rec.update(wall_s=round(time.time() - t0, 1), error="timeout",
+                   exit_class="timeout")
+        return rec
+    out = proc.stdout + proc.stderr
+    rec["wall_s"] = round(time.time() - t0, 1)
+    rec["returncode"] = proc.returncode
+    if proc.returncode != 0:
+        cls = EXIT_CLASSES.get(proc.returncode)
+        rec["exit_class"] = cls or f"exit-{proc.returncode}"
+        rec["error"] = (cls or
+                        (out.strip().splitlines()[-1] if out.strip()
+                         else "?"))
+        return rec
+    rec.update(parse_stdout_metrics(out))
+    if metrics_dir is not None:
+        summary = _read_summary(metrics_dir)
+        if summary is not None:
+            rec["per_chip"] = summary.get("images_per_sec_per_chip",
+                                          rec.get("per_chip"))
+            rec["step_ms"] = summary.get("mean_step_ms",
+                                         rec.get("step_ms"))
+            mfu = summary.get("mfu")
+            if mfu is not None:
+                rec["mfu_pct"] = round(100.0 * mfu, 2)
+            gp = summary.get("goodput")
+            # NaN goodput (no ledger) serializes as "NaN"/null — keep
+            # only a real fraction
+            if isinstance(gp, (int, float)) and gp == gp:
+                rec["goodput"] = round(gp, 4)
+    if "per_chip" not in rec:
+        rec["error"] = "no-throughput-line"
+    return rec
+
+
+def score(rec: dict) -> float:
+    """Goodput-adjusted per-chip throughput (the search objective).
+    Failed runs score 0."""
+    if rec.get("error"):
+        return 0.0
+    per_chip = rec.get("per_chip") or 0.0
+    gp = rec.get("goodput")
+    if isinstance(gp, (int, float)) and gp == gp and gp > 0:
+        return per_chip * gp
+    return per_chip

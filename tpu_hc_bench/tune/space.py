@@ -1,0 +1,319 @@
+"""The tunable levers per zoo member, and the seeded best-known configs.
+
+A *candidate* is one assignment of the levers BASELINE.md's manual
+sweeps actually moved:
+
+- ``batch_size`` — power-of-two ladder around the seeded batch
+- ``gradient_accumulation_steps`` — 1..64 (microbatching without
+  remat's recompute)
+- ``accum_dtype`` — f32 (exact mean) vs bf16 (the HBM lever)
+- ``gradient_checkpointing`` — remat: FLOPs for activation HBM
+- ``scan_layers`` — one compiled layer body (decoder families)
+- ``fusion_threshold_bytes`` — the allreduce combine threshold
+- ``variable_update`` — psum vs the zero1 sharded-optimizer arm
+
+Per-member validity rules are structural (accum must divide the batch,
+the dtype lever needs accum > 1, remat needs a transformer, scan needs
+a decoder); everything deeper — the zero1 composition matrix, the
+eval/forward-only exclusions — is enforced by ``BenchmarkConfig
+.resolve()`` and handled by the pruner as a free flag-time skip.
+
+``SEED_CONFIGS`` is the machine-readable form of the BASELINE.md zoo
+table's best-known single-chip configs.  It used to live as
+``DEFAULT_MATRIX``/``EXTRA_FLAGS`` in ``scripts/sweep_zoo.py``; the
+sweep now imports it from here so the sweep, the tuner, and the HBM
+model all share one copy of that knowledge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_hc_bench.flags import (
+    DEFAULT_FUSION_THRESHOLD_BYTES,
+    BenchmarkConfig,
+)
+
+__all__ = [
+    "Candidate", "SEED_CONFIGS", "seed_candidate", "member_space",
+    "seed_matrix", "seed_extra_flags", "LEVERS",
+]
+
+# The lever fields a candidate may override (everything else rides the
+# member's base flags or the BenchmarkConfig defaults).
+LEVERS = (
+    "batch_size",
+    "gradient_accumulation_steps",
+    "accum_dtype",
+    "gradient_checkpointing",
+    "scan_layers",
+    "fusion_threshold_bytes",
+    "variable_update",
+)
+
+# member -> best-known single-chip config (BASELINE.md zoo table).
+# "batch" is the per-chip batch; "accum"/"accum_dtype" the microbatch
+# levers; "base" the member-fixed flags the search does not move
+# (attention kernel choice).  The accumulation members' batches exceed
+# HBM as plain one-shot batches and fit only as accum microbatches —
+# that pairing seeds the pruner's HBM model.
+SEED_CONFIGS: dict[str, dict] = {
+    "trivial":          {"batch": 512},
+    "lenet":            {"batch": 2048},
+    "alexnet":          {"batch": 2048, "accum": 4},
+    "overfeat":         {"batch": 4096, "accum": 16},
+    "googlenet":        {"batch": 256},
+    "mobilenet":        {"batch": 256},
+    "nasnet":           {"batch": 128},
+    "nasnetlarge":      {"batch": 128, "accum": 8},
+    "densenet40_k12":   {"batch": 512},
+    "densenet100_k12":  {"batch": 256},
+    "resnet18":         {"batch": 256},
+    "resnet34":         {"batch": 256},
+    "resnet50":         {"batch": 128},
+    "resnet101":        {"batch": 512, "accum": 8},
+    "resnet152":        {"batch": 512, "accum": 8},
+    "resnet50_v2":      {"batch": 1024, "accum": 8},
+    "resnet101_v2":     {"batch": 512, "accum": 8},
+    "resnet152_v2":     {"batch": 512, "accum": 8},
+    "resnet20_cifar":   {"batch": 1024},
+    "resnet56_cifar":   {"batch": 512},
+    "resnet110_cifar":  {"batch": 256},
+    "vgg11":            {"batch": 1024, "accum": 8},
+    "vgg16":            {"batch": 1024, "accum": 8},
+    "vgg19":            {"batch": 1024, "accum": 8},
+    "inception3":       {"batch": 128},
+    "vit_b16":          {"batch": 256, "accum": 4},
+    "vit_l16":          {"batch": 512, "accum": 8},
+    "inception4":       {"batch": 512, "accum": 8},
+    "bert_base":        {"batch": 1024, "accum": 8},
+    "bert_large":       {"batch": 1024, "accum": 32},
+    "gpt2":             {"batch": 128, "accum": 8,
+                         "base": {"attention_impl": "flash"}},
+    "gpt2_medium":      {"batch": 64, "accum": 16,
+                         "base": {"attention_impl": "flash"}},
+    # round 5: the bf16 accumulator unlocked batch scaling past the
+    # bs=16 OOM wall (microbatch 8; BASELINE.md round 5) — +37%
+    "gpt2_moe":         {"batch": 512, "accum": 64, "accum_dtype": "bf16",
+                         "base": {"attention_impl": "flash"}},
+    "llama_1b":         {"batch": 2,
+                         "base": {"attention_impl": "flash"}},
+    # round 4: both members' old tf_cnn-default batches starved the
+    # chip — these are the measured TPU operating points
+    "ncf":              {"batch": 1048576},
+    "deepspeech2":      {"batch": 256},
+}
+
+_ACCUM_LADDER = (1, 2, 4, 8, 16, 32, 64)
+_FUSION_LADDER = (DEFAULT_FUSION_THRESHOLD_BYTES,
+                  DEFAULT_FUSION_THRESHOLD_BYTES // 4)
+
+_CONFIG_DEFAULTS = {f.name: f.default
+                    for f in dataclasses.fields(BenchmarkConfig)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point in a member's search space.
+
+    ``overrides`` maps BenchmarkConfig field names to lever values;
+    ``base`` carries the member-fixed flags the search does not move
+    (e.g. ``attention_impl=flash`` for the decoder families).
+    """
+
+    model: str
+    overrides: tuple[tuple[str, object], ...]   # sorted, hashable
+    base: tuple[tuple[str, object], ...] = ()
+
+    @staticmethod
+    def make(model: str, overrides: dict, base: dict | None = None
+             ) -> "Candidate":
+        for k in overrides:
+            if k not in LEVERS:
+                raise ValueError(f"not a tunable lever: {k!r}")
+        return Candidate(
+            model=model,
+            overrides=tuple(sorted(overrides.items())),
+            base=tuple(sorted((base or {}).items())),
+        )
+
+    @property
+    def key(self) -> str:
+        """Stable identity for journal/registry bookkeeping."""
+        parts = [f"{k}={v}" for k, v in self.overrides]
+        return ",".join(parts) or "defaults"
+
+    @property
+    def batch_size(self) -> int:
+        d = dict(self.overrides)
+        return int(d.get("batch_size", _CONFIG_DEFAULTS["batch_size"]))
+
+    def all_overrides(self) -> dict:
+        """base + lever overrides, one dict (base first: a lever that
+        shadows a base flag wins)."""
+        out = dict(self.base)
+        out.update(dict(self.overrides))
+        return out
+
+    def to_config(self, **extra) -> BenchmarkConfig:
+        """An UNresolved BenchmarkConfig with this candidate applied
+        (the pruner calls ``.resolve()`` on it to get flag-time
+        rejections for free)."""
+        kwargs = dict(self.all_overrides())
+        kwargs.update(extra)
+        return BenchmarkConfig(model=self.model, **kwargs)
+
+    def to_flags(self) -> list[str]:
+        """The candidate as driver CLI flags (batch rides the
+        positional contract, so it is excluded here)."""
+        out = []
+        for k, v in {**dict(self.base), **dict(self.overrides)}.items():
+            if k == "batch_size":
+                continue
+            if isinstance(v, bool):
+                v = "True" if v else "False"
+            out.append(f"--{k}={v}")
+        return sorted(out)
+
+
+def seed_candidate(model: str) -> Candidate:
+    """The member's seeded best-known config as a Candidate (identity
+    point of the search space; also the HBM model's anchor)."""
+    seed = SEED_CONFIGS.get(model)
+    if seed is None:
+        raise ValueError(
+            f"no seeded config for {model!r} (not a sweep-matrix member); "
+            f"pass an explicit space")
+    overrides: dict = {"batch_size": seed["batch"]}
+    if seed.get("accum", 1) > 1:
+        overrides["gradient_accumulation_steps"] = seed["accum"]
+    if seed.get("accum_dtype"):
+        overrides["accum_dtype"] = seed["accum_dtype"]
+    return Candidate.make(model, overrides, seed.get("base"))
+
+
+def _pow2_ladder(center: int, down: int = 2, up: int = 2) -> list[int]:
+    """Power-of-two ladder around ``center``: center/2^down ..
+    center*2^up, floored at 1."""
+    out = []
+    for e in range(-down, up + 1):
+        v = center * (2 ** e) if e >= 0 else center // (2 ** -e)
+        if v >= 1 and v not in out:
+            out.append(int(v))
+    return out
+
+
+def _member_levers(model: str) -> dict[str, bool]:
+    """Which structural levers this member supports (remat needs a
+    transformer, scan a decoder family).  Spec lookup is best-effort so
+    the space module stays importable without the models package."""
+    try:
+        from tpu_hc_bench.models import get_model_spec
+
+        spec = get_model_spec(model)
+        return {"remat": bool(spec.attention or spec.is_text),
+                "scan": bool(spec.causal_lm)}
+    except Exception:
+        return {"remat": False, "scan": False}
+
+
+def member_space(model: str, mode: str = "axes",
+                 seed: Candidate | None = None) -> list[Candidate]:
+    """Enumerate the member's candidates, seed first.
+
+    ``mode="axes"`` (default) is the manual-sweep shape automated: vary
+    ONE lever at a time off the seeded best-known config — the batch
+    ladder, the accum ladder, the dtype/remat/scan/fusion/arm toggles.
+    ``mode="grid"`` crosses batch x accum x dtype for members where the
+    interaction matters (the OOM-wall members), still toggling the
+    remaining levers axis-wise.  Structurally invalid points (accum not
+    dividing batch, dtype lever without accum) are never generated;
+    deeper validity is the pruner's job.
+    """
+    if mode not in ("axes", "grid"):
+        raise ValueError(f"mode must be axes|grid: {mode!r}")
+    seed = seed or seed_candidate(model)
+    levers = _member_levers(model)
+    sd = dict(seed.overrides)
+    base = dict(seed.base)
+    seed_batch = int(sd.get("batch_size", _CONFIG_DEFAULTS["batch_size"]))
+    seed_accum = int(sd.get("gradient_accumulation_steps", 1))
+
+    out: list[Candidate] = [seed]
+    seen = {seed.key}
+
+    def add(overrides: dict):
+        # structural validity: accum divides batch, microbatch >= 1,
+        # the dtype lever only exists with accum > 1
+        b = int(overrides.get("batch_size", seed_batch))
+        a = int(overrides.get("gradient_accumulation_steps", 1))
+        if a > 1 and (b % a or b // a < 1):
+            return
+        if overrides.get("accum_dtype", "f32") != "f32" and a <= 1:
+            return
+        c = Candidate.make(model, overrides, base)
+        if c.key not in seen:
+            seen.add(c.key)
+            out.append(c)
+
+    def vary(**delta):
+        o = dict(sd)
+        for k, v in delta.items():
+            if v is None:
+                o.pop(k, None)
+            else:
+                o[k] = v
+        # normalize: accum==1 and f32 are the defaults, drop them so
+        # equal configs get equal keys
+        if o.get("gradient_accumulation_steps") == 1:
+            o.pop("gradient_accumulation_steps", None)
+            o.pop("accum_dtype", None)
+        if o.get("accum_dtype") == "f32":
+            o.pop("accum_dtype", None)
+        add(o)
+
+    batches = _pow2_ladder(seed_batch)
+    accums = [a for a in _ACCUM_LADDER if a != seed_accum]
+
+    if mode == "grid":
+        dtypes = ("f32", "bf16")
+        for b in batches:
+            for a in _ACCUM_LADDER:
+                for dt in dtypes:
+                    vary(batch_size=b,
+                         gradient_accumulation_steps=a if a > 1 else None,
+                         accum_dtype=dt if a > 1 else None)
+    else:
+        for b in batches:
+            vary(batch_size=b)
+        for a in accums:
+            vary(gradient_accumulation_steps=a if a > 1 else None)
+        if seed_accum > 1:
+            cur = sd.get("accum_dtype", "f32")
+            vary(accum_dtype="bf16" if cur == "f32" else "f32")
+
+    # the toggle levers are axis-wise in both modes
+    if levers["remat"]:
+        vary(gradient_checkpointing=True)
+    if levers["scan"]:
+        vary(scan_layers=True)
+    for ft in _FUSION_LADDER:
+        if ft != sd.get("fusion_threshold_bytes",
+                        DEFAULT_FUSION_THRESHOLD_BYTES):
+            vary(fusion_threshold_bytes=ft)
+    vary(variable_update="zero1")
+    return out
+
+
+# --- sweep_zoo.py compatibility views ---------------------------------
+
+
+def seed_matrix() -> list[tuple[str, int]]:
+    """(model, per-chip batch) pairs — the sweep's DEFAULT_MATRIX."""
+    return [(m, cfg["batch"]) for m, cfg in SEED_CONFIGS.items()]
+
+
+def seed_extra_flags(model: str) -> list[str]:
+    """The member's seeded non-batch flags in CLI form — the sweep's
+    old EXTRA_FLAGS entry."""
+    return seed_candidate(model).to_flags()
