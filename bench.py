@@ -89,6 +89,10 @@ def main() -> int:
         # synthetic runs resolve the flag to off with a translation note
         data_dir=os.environ.get("BENCH_DATA_DIR") or None,
         input_service=os.environ.get("BENCH_INPUT_SERVICE", "auto"),
+        # round 15: pre-run AOT memory check (obs.memory) —
+        # BENCH_HBM_BUDGET=16GB|auto warns loudly BEFORE the run pays
+        # for the full compile when the step program cannot fit
+        hbm_budget=os.environ.get("BENCH_HBM_BUDGET") or None,
     )
     cfg = flags.BenchmarkConfig(**cfg_kwargs).resolve()
     if (config_mode == "auto" and cfg.config_source == "baseline"
@@ -163,6 +167,15 @@ def main() -> int:
             # a different experiment — obs diff and the BENCH history
             # must both see it as config drift, not a regression
             "resume": result.resume,
+            # measured device memory (round 15, obs.memory): the run's
+            # HBM high water (mem_source says allocator peak vs the
+            # live-arrays fallback) and the step program's AOT
+            # argument/temp/output byte account — the BENCH history
+            # shows a lever change moving memory BEFORE it OOMs
+            "peak_hbm_bytes": result.peak_hbm_bytes,
+            "hbm_bytes_limit": result.hbm_bytes_limit,
+            "mem_source": result.mem_source,
+            "memory_analysis": result.memory_analysis,
             # config provenance (round 14): manual = hand-set flags,
             # auto = a tuned registry row was applied (the row rides
             # along), baseline = --config=auto found no row and fell

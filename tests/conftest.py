@@ -89,3 +89,55 @@ def mesh8():
     from tpu_hc_bench.topology import build_mesh, discover_layout
 
     return build_mesh(discover_layout())
+
+
+def ceiling_file(tmp_path) -> str:
+    """The ONE copy of the test fabric-ceiling sweep (schema 1), shared
+    by the session ``rewind_run`` fixture and test_goodput's ceiling
+    unit tests — two drifting copies of the sweep schema is how table
+    rot starts."""
+    import json
+
+    data = {
+        "schema": 1, "world_size": 8, "device_kind": "cpu",
+        "sweeps": {"allreduce": [
+            {"op": "allreduce", "world_size": 8, "message_bytes": 1024,
+             "mean_us": 10.0, "algbw_gbps": 0.1, "busbw_gbps": 0.18},
+            {"op": "allreduce", "world_size": 8,
+             "message_bytes": 1 << 20, "mean_us": 100.0,
+             "algbw_gbps": 10.0, "busbw_gbps": 17.5},
+        ]},
+    }
+    p = tmp_path / "sweep.json"
+    p.write_text(json.dumps(data))
+    return str(p)
+
+
+@pytest.fixture(scope="session")
+def rewind_run(tmp_path_factory):
+    """ONE tiny driver run with an injected rewind fault, shared by
+    every default-lane e2e assertion (test_goodput's acceptance checks
+    AND test_memory_obs's ledger/report checks) — session scope so the
+    lane pays for a single run no matter how many modules consume it.
+
+    nan at step 1: the double-buffered guard fetch processes window 2's
+    counters at window 4, so the rewind lands mid-run with clean replay
+    steps after it (goodput strictly between 0 and 1).
+    """
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.train import driver
+
+    tmp = tmp_path_factory.mktemp("shared_e2e")
+    ceiling = ceiling_file(tmp)
+    mdir = str(tmp / "m")
+    cfg = flags.BenchmarkConfig(
+        batch_size=2, num_warmup_batches=1, num_batches=6,
+        display_every=2, model="trivial", num_classes=10,
+        init_learning_rate=0.05, on_nonfinite="rewind",
+        inject_fault="nan_loss@1", train_dir=str(tmp / "ck"),
+        metrics_dir=mdir, fabric_ceiling=ceiling,
+    ).resolve()
+    out: list[str] = []
+    res = driver.run_benchmark(cfg, print_fn=out.append)
+    return {"dir": mdir, "ceiling": ceiling, "result": res,
+            "out": out, "tmp": tmp}

@@ -16,8 +16,9 @@ Five sections, matching the round-9 acceptance contract:
 5. End-to-end: ONE driver run with an injected rewind fault feeds the
    acceptance assertions (goodput < 1 with rewind attributed, MFU line
    labeled with its source, ceiling line under --fabric_ceiling,
-   ``obs watch`` rendering and exiting cleanly) — shared module-scoped
-   fixture, so the default lane pays for a single tiny run.
+   ``obs watch`` rendering and exiting cleanly) — the session-scoped
+   ``rewind_run`` fixture in conftest.py, shared with test_memory_obs,
+   so the default lane pays for a single tiny run.
 """
 
 from __future__ import annotations
@@ -153,13 +154,16 @@ def test_fleet_heartbeats_roundtrip(tmp_path):
     w = fleet.FleetWriter(str(tmp_path), process_index=3)
     assert w.enabled
     w.heartbeat(step=10, step_ewma_ms=12.5)
-    w.heartbeat(step=20, step_ewma_ms=11.0,
-                mem={"d0": {"peak_bytes_in_use": 123}})
+    w.heartbeat(step=20, step_ewma_ms=11.0, mem_peak_bytes=123)
     w.close()
     beats = fleet.read_heartbeats(str(tmp_path))
     assert list(beats) == [3]
     assert beats[3][-1]["step"] == 20
-    assert beats[3][-1]["peak_bytes_in_use"] == 123
+    # the ONE unified heartbeat memory field name (round 15), readable
+    # through the accessor that also tolerates pre-unification dirs
+    assert beats[3][-1]["mem_peak_bytes"] == 123
+    assert fleet.heartbeat_mem_peak(beats[3][-1]) == 123
+    assert fleet.heartbeat_mem_peak({"peak_bytes_in_use": 7}) == 7
     # disabled writer no-ops
     off = fleet.FleetWriter(None)
     assert not off.enabled
@@ -273,20 +277,9 @@ def test_grad_allreduce_bytes():
     assert efficiency.grad_allreduce_bytes(params, "bf16") == 20 * 2
 
 
-def ceiling_file(tmp_path) -> str:
-    data = {
-        "schema": 1, "world_size": 8, "device_kind": "cpu",
-        "sweeps": {"allreduce": [
-            {"op": "allreduce", "world_size": 8, "message_bytes": 1024,
-             "mean_us": 10.0, "algbw_gbps": 0.1, "busbw_gbps": 0.18},
-            {"op": "allreduce", "world_size": 8,
-             "message_bytes": 1 << 20, "mean_us": 100.0,
-             "algbw_gbps": 10.0, "busbw_gbps": 17.5},
-        ]},
-    }
-    p = tmp_path / "sweep.json"
-    p.write_text(json.dumps(data))
-    return str(p)
+# the ONE copy of the test fabric-ceiling sweep lives in conftest.py,
+# next to the session rewind_run fixture that also consumes it
+from conftest import ceiling_file  # noqa: E402
 
 
 def test_load_fabric_ceiling(tmp_path):
@@ -531,25 +524,9 @@ def test_corrupt_manifest_degrades(tmp_path, capsys):
 # 5. end-to-end: one rewind-injected run feeds the acceptance checks
 
 
-@pytest.fixture(scope="module")
-def rewind_run(tmp_path_factory):
-    tmp = tmp_path_factory.mktemp("goodput_e2e")
-    ceiling = ceiling_file(tmp)
-    mdir = str(tmp / "m")
-    # nan at step 1: the double-buffered guard fetch processes window
-    # 2's counters at window 4, so the rewind lands mid-run with clean
-    # replay steps after it (goodput strictly between 0 and 1)
-    cfg = flags.BenchmarkConfig(
-        batch_size=2, num_warmup_batches=1, num_batches=6,
-        display_every=2, model="trivial", num_classes=10,
-        init_learning_rate=0.05, on_nonfinite="rewind",
-        inject_fault="nan_loss@1", train_dir=str(tmp / "ck"),
-        metrics_dir=mdir, fabric_ceiling=ceiling,
-    ).resolve()
-    out: list[str] = []
-    res = driver.run_benchmark(cfg, print_fn=out.append)
-    return {"dir": mdir, "ceiling": ceiling, "result": res,
-            "out": out, "tmp": tmp}
+# the shared rewind-injected driver run lives in conftest.py
+# (session-scoped `rewind_run`): test_memory_obs consumes the same
+# single run, so the default lane still pays for it exactly once
 
 
 def test_rewind_run_goodput_below_one(rewind_run):

@@ -1,0 +1,637 @@
+"""Measured device memory (``obs.memory``): report, ledger, forensics.
+
+Six sections, matching the round-15 acceptance contract:
+
+1. Compile-time report: ``memory_analysis_of_compiled`` on a real CPU
+   AOT compile, the analytic params/opt/batch table, and the >10%
+   argument-byte disagreement tripwire (the MFU cross-check's twin).
+2. Runtime ledger: phase attribution and high-water tracking with an
+   injected sampler, the pure ``fold_memory_records`` over hand-built
+   streams (including the pre-round-15 legacy record shape), rendering.
+3. OOM/emergency forensics: error classification, live-buffer
+   aggregation, and the best-effort ``memory_dump.json`` writer.
+4. ``--hbm_budget``: spec parsing, auto resolution on a backend with no
+   allocator stats, verdict lines, flag-time validation.
+5. The tune feedback loop: measured HBM anchors beating the seeded
+   guess, journal-row joining, ``hbm_source`` provenance in skips, the
+   mid-search measured re-check, and ``tune show --journal`` rendering.
+6. End-to-end against the SHARED session-scoped ``rewind_run`` driver
+   fixture (conftest.py — no new default-lane driver run): memory
+   records per sync window, the summary's peak/source fields, the
+   unified heartbeat name, summarize/diff/watch rendering.  The
+   emergency-save forensics subprocess proof is slow-marked.
+
+Plus the ``memory-probe-in-hot-loop`` analysis lint fixtures.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_hc_bench import flags
+from tpu_hc_bench.analysis import lints
+from tpu_hc_bench.obs import fleet, goodput
+from tpu_hc_bench.obs import memory as mem
+from tpu_hc_bench.obs import metrics as obs_metrics
+from tpu_hc_bench.obs import watch as watch_mod
+from tpu_hc_bench.obs.__main__ import main as obs_main
+from tpu_hc_bench.tune import prune, search, space
+from tpu_hc_bench.tune.__main__ import main as tune_main
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------
+# 1. compile-time report
+
+
+def test_memory_analysis_of_compiled_cpu():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: (x @ x).sum())
+    compiled = f.lower(
+        jax.ShapeDtypeStruct((64, 64), jnp.float32)).compile()
+    ma = mem.memory_analysis_of_compiled(compiled)
+    assert ma is not None
+    assert ma["argument_bytes"] == 64 * 64 * 4
+    assert ma["output_bytes"] == 4
+    # total = args + out + temp + code - aliased, clamped at 0
+    assert ma["total_bytes"] == (
+        ma["argument_bytes"] + ma["output_bytes"]
+        + ma.get("temp_bytes", 0) + ma.get("generated_code_bytes", 0)
+        - ma.get("alias_bytes", 0))
+
+
+def test_memory_analysis_tolerates_absent_backends():
+    class Raises:
+        def memory_analysis(self):
+            raise NotImplementedError
+
+    class NoneShaped:
+        def memory_analysis(self):
+            return None
+
+    class DictShaped:
+        def memory_analysis(self):
+            return {"argument_size_in_bytes": 10, "temp_size_in_bytes": 5}
+
+    assert mem.memory_analysis_of_compiled(Raises()) is None
+    assert mem.memory_analysis_of_compiled(NoneShaped()) is None
+    ma = mem.memory_analysis_of_compiled(DictShaped())
+    assert ma == {"argument_bytes": 10, "temp_bytes": 5,
+                  "total_bytes": 15}
+
+
+def test_analytic_memory_table():
+    class State:
+        params = {"w": np.zeros((4, 4), np.float32)}        # 64 B
+        opt_state = [np.zeros(4, np.float32)] * 2           # 32 B
+
+    batch = {"x": np.zeros((2, 8), np.float32)}             # 64 B
+    t = mem.analytic_memory_table(State(), batch)
+    assert t == {"params_bytes": 64, "opt_bytes": 32,
+                 "batch_bytes": 64, "state_bytes": 160}
+    # the PP (params, opt_state) tuple shape
+    t2 = mem.analytic_memory_table(
+        ({"w": np.zeros((4, 4), np.float32)},
+         [np.zeros(4, np.float32)]), None)
+    assert t2["params_bytes"] == 64 and t2["opt_bytes"] == 16
+
+
+def test_memory_report_disagreement_tripwire():
+    analytic = {"params_bytes": 80, "opt_bytes": 10, "batch_bytes": 10,
+                "state_bytes": 100}
+    ok = mem.memory_report({"argument_bytes": 105, "total_bytes": 205},
+                           analytic)
+    assert ok["mem_source"] == "measured" and not ok.get("args_disagree")
+    bad = mem.memory_report({"argument_bytes": 150, "total_bytes": 250},
+                            analytic)
+    assert bad["args_disagree"]
+    assert bad["args_disagreement"] == pytest.approx(0.5)
+    lines = mem.memory_report_lines(bad)
+    assert any("WARNING" in ln and "disagree" in ln for ln in lines)
+    # no AOT analysis: the table is still printed, labeled unavailable
+    none = mem.memory_report(None, analytic)
+    assert none["mem_source"] == "analytic"
+    lines = mem.memory_report_lines(none)
+    assert "unavailable" in lines[0] and "analytic" in lines[0]
+
+
+# ---------------------------------------------------------------------
+# 2. runtime ledger + the pure fold
+
+
+def test_memory_ledger_phase_attribution():
+    samples = iter([
+        {"source": "memory_stats", "bytes_in_use": 50, "peak_bytes": 100,
+         "bytes_limit": 1000},
+        {"source": "memory_stats", "bytes_in_use": 70, "peak_bytes": 300,
+         "bytes_limit": 1000},
+        {"source": "memory_stats", "bytes_in_use": 60, "peak_bytes": 300,
+         "bytes_limit": 1000},
+    ])
+    led = mem.MemoryLedger(sample_fn=lambda: next(samples))
+    led.sample("compile")
+    rec = led.sample("step", step=4)
+    assert rec["phase"] == "step" and rec["step"] == 4
+    led.sample("checkpoint_async", step=6)
+    # the global peak is the allocator's cumulative high water, stamped
+    # with the phase during which it ROSE; per-phase maxima come from
+    # the sample-point in-use bytes — the cumulative peak (300) must
+    # not bleed into checkpoint_async, which was polled after it
+    assert led.peak_bytes == 300 and led.peak_phase == "step"
+    assert led.per_phase == {"compile": 50, "step": 70,
+                             "checkpoint_async": 60}
+    fold = led.fold()
+    assert fold["bytes_limit"] == 1000
+    assert fold["peak_phase"] == "step"
+
+
+def test_memory_ledger_live_arrays_fallback_carries_high_water():
+    vals = iter([40, 90, 30])
+    led = mem.MemoryLedger(sample_fn=lambda: {
+        "source": "live_arrays", "bytes_in_use": next(vals),
+        "peak_bytes": None, "bytes_limit": None})
+    led.sample("compile")
+    led.sample("step")
+    rec = led.sample("step")
+    # the stream record carries the ledger's running high water, so the
+    # offline fold sees the same peak the in-process ledger does
+    assert rec["peak_bytes"] == 90 and led.peak_bytes == 90
+    assert led.peak_phase == "step"
+    assert led.fold()["source"] == "live_arrays"
+
+
+def test_ledger_empty_fold_is_none():
+    led = mem.MemoryLedger(sample_fn=lambda: {
+        "source": "live_arrays", "bytes_in_use": 0, "peak_bytes": None})
+    assert led.fold() is None
+    led.sample("step")
+    assert led.fold() is None
+
+
+def test_fold_memory_records_phases_and_legacy():
+    recs = [
+        {"kind": "window", "step": 2},
+        {"kind": "memory", "phase": "compile", "bytes_in_use": 10,
+         "peak_bytes": 80, "source": "memory_stats", "bytes_limit": 500},
+        {"kind": "memory", "phase": "step", "bytes_in_use": 60,
+         "peak_bytes": 200, "source": "memory_stats", "bytes_limit": 500},
+    ]
+    fold = mem.fold_memory_records(recs)
+    assert fold["peak_bytes"] == 200 and fold["peak_phase"] == "step"
+    # per-phase from the sample-point in-use bytes, not the cumulative
+    # allocator peak (MemoryLedger.sample's attribution rule)
+    assert fold["per_phase"] == {"compile": 10, "step": 60}
+    assert fold["bytes_limit"] == 500
+    # the pre-round-15 end-of-run record shape still folds
+    legacy = mem.fold_memory_records([
+        {"kind": "memory", "supported": True,
+         "devices": {"d0": {"peak_bytes_in_use": 123},
+                     "d1": {"peak_bytes_in_use": 99}}}])
+    assert legacy["peak_bytes"] == 123 and legacy["peak_phase"] is None
+    assert mem.fold_memory_records([]) is None
+    assert mem.fold_memory_records([{"kind": "memory",
+                                     "bytes_in_use": 0}]) is None
+
+
+def test_memory_lines_render_phase_order():
+    fold = {"peak_bytes": 300 << 20, "peak_phase": "step",
+            "per_phase": {"checkpoint_async": 10 << 20,
+                          "step": 300 << 20, "compile": 200 << 20},
+            "source": "memory_stats", "bytes_limit": 1 << 30}
+    lines = mem.memory_lines(fold)
+    assert "peak 300.0 MiB" in lines[0]
+    assert "of 1.0 GiB limit (29%)" in lines[0]
+    assert "phase step" in lines[0]
+    # per-phase peaks render in ledger phase order (compile before step)
+    assert lines[1].index("compile") < lines[1].index("step")
+    assert mem.memory_lines(None) == []
+    assert goodput.PHASES  # the order source the renderer leans on
+
+
+# ---------------------------------------------------------------------
+# 3. forensics
+
+
+def test_is_oom_error_spellings():
+    assert mem.is_oom_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate"))
+    assert mem.is_oom_error(RuntimeError("failed to allocate 4.2G"))
+    assert not mem.is_oom_error(ValueError("shape mismatch"))
+
+
+def test_live_buffer_breakdown_aggregates_by_shape():
+    import jax.numpy as jnp
+
+    keep = [jnp.ones((17, 23), jnp.float32) for _ in range(3)]
+    bd = mem.live_buffer_breakdown(top_k=1000)
+    rows = [r for r in bd["top_buffers"]
+            if r["shape"] == [17, 23] and r["dtype"] == "float32"]
+    assert rows and rows[0]["count"] >= 3
+    assert rows[0]["nbytes"] >= 3 * 17 * 23 * 4
+    assert bd["total_live_bytes"] >= rows[0]["nbytes"]
+    assert bd["buffer_count"] >= 3
+    # largest-first ordering
+    sizes = [r["nbytes"] for r in bd["top_buffers"]]
+    assert sizes == sorted(sizes, reverse=True)
+    del keep
+
+
+def test_dump_forensics_writes_and_never_raises(tmp_path):
+    printed: list[str] = []
+    path = mem.dump_forensics(str(tmp_path), reason="oom", step=7,
+                              error="RESOURCE_EXHAUSTED: boom",
+                              print_fn=printed.append)
+    assert path and os.path.basename(path) == mem.MEMORY_DUMP_NAME
+    payload = json.loads(Path(path).read_text())
+    assert payload["reason"] == "oom" and payload["step"] == 7
+    assert payload["error"].startswith("RESOURCE_EXHAUSTED")
+    assert "top_buffers" in payload and "total_live_bytes" in payload
+    assert printed and "memory forensics (oom)" in printed[0]
+    # best-effort contract: an unwritable target returns None, no raise
+    assert mem.dump_forensics(
+        str(tmp_path / "nope" / "nope"), reason="oom") is None
+
+
+# ---------------------------------------------------------------------
+# 4. --hbm_budget
+
+
+def test_parse_hbm_budget():
+    assert mem.parse_hbm_budget(None) is None
+    assert mem.parse_hbm_budget("off") is None
+    assert mem.parse_hbm_budget("0") is None
+    assert mem.parse_hbm_budget("auto") == "auto"
+    assert mem.parse_hbm_budget("16GB") == 16 * 2**30
+    assert mem.parse_hbm_budget("900mb") == 900 * 2**20
+    assert mem.parse_hbm_budget("1.5GiB") == int(1.5 * 2**30)
+    assert mem.parse_hbm_budget("12345") == 12345
+    with pytest.raises(ValueError, match="hbm_budget"):
+        mem.parse_hbm_budget("lots")
+    with pytest.raises(ValueError, match="> 0"):
+        mem.parse_hbm_budget("-4GB")
+
+
+def test_resolve_hbm_budget_auto_without_allocator_stats():
+    # explicit bytes pass through untouched
+    assert mem.resolve_hbm_budget_bytes(123) == (123, None)
+    assert mem.resolve_hbm_budget_bytes(None) == (None, None)
+    # the CPU backend exposes no bytes_limit: auto degrades to a loud
+    # note instead of silently skipping the check
+    bytes_, note = mem.resolve_hbm_budget_bytes("auto")
+    assert bytes_ is None and "bytes_limit" in note
+    assert mem.budget_lines(None, None, note)[0].startswith("WARNING")
+
+
+def test_budget_lines_verdicts():
+    measured = {"argument_bytes": 1 << 30, "temp_bytes": 2 << 30,
+                "output_bytes": 0, "total_bytes": 3 << 30}
+    over = mem.budget_lines(measured, 2 << 30)
+    assert over[0].startswith("WARNING") and "EXCEEDS" in over[0]
+    fits = mem.budget_lines(measured, 4 << 30)
+    assert "fits the budget" in fits[0] and "75%" in fits[0]
+    assert mem.budget_lines(None, 2 << 30)[0].startswith("WARNING")
+    assert mem.budget_lines(measured, None) == []
+
+
+def test_flags_validate_hbm_budget():
+    cfg = flags.BenchmarkConfig(hbm_budget="16GB").resolve()
+    assert cfg.hbm_budget == "16GB"
+    with pytest.raises(ValueError, match="hbm_budget"):
+        flags.BenchmarkConfig(hbm_budget="lots").resolve()
+    ns = flags.build_parser().parse_args(["--hbm_budget", "auto"])
+    assert ns.hbm_budget == "auto"
+
+
+# ---------------------------------------------------------------------
+# 5. the tune feedback loop
+
+
+def test_hbm_model_from_measurements():
+    limit = 1000
+    rows = [{"overrides": {"batch_size": 64},
+             "peak_hbm_bytes": 500, "hbm_bytes_limit": limit}]
+    m = prune.HbmModel.from_measurements(rows, headroom=1.25)
+    # 64 * 1000 / (500 * 1.25) = 102 — measured extrapolation, and the
+    # anchor IS the estimate: no seeded-guess headroom stacked on top
+    assert m.source == "measured" and m.headroom == 1.0
+    assert m.max_microbatch == 102
+    # an OOM'd row is ground truth the other way: cap strictly below
+    rows.append({"overrides": {"batch_size": 96},
+                 "error": "RESOURCE_EXHAUSTED: oom"})
+    m2 = prune.HbmModel.from_measurements(rows, headroom=1.25)
+    assert m2.max_microbatch == 95
+    # rows without any measurement yield no model (fall back to seeded)
+    assert prune.HbmModel.from_measurements(
+        [{"overrides": {"batch_size": 8}}]) is None
+    # a peak-only row (no limit) anchors at its own measured microbatch
+    m3 = prune.HbmModel.from_measurements(
+        [{"overrides": {"batch_size": 32,
+                        "gradient_accumulation_steps": 4},
+          "peak_hbm_bytes": 10}])
+    assert m3.max_microbatch == 8
+
+
+def test_measured_rows_from_journal_join():
+    journal = {
+        "model": "trivial",
+        "candidates": {
+            "batch_size=64": {"overrides": {"batch_size": 64}},
+            "batch_size=128": {"overrides": {"batch_size": 128}},
+        },
+        "measurements": {
+            "batch_size=64": {"0": {"peak_hbm_bytes": 500,
+                                    "hbm_bytes_limit": 1000}},
+            "batch_size=128": {"0": {"per_chip": 5.0}},   # no memory
+        },
+    }
+    rows = prune.measured_rows_from_journal(journal)
+    assert len(rows) == 1
+    assert rows[0]["overrides"] == {"batch_size": 64}
+    assert prune.measured_rows_from_journal(journal, model="lenet") == []
+
+
+def test_hbm_model_for_prefers_measured():
+    rows = [{"overrides": {"batch_size": 64},
+             "peak_hbm_bytes": 900, "hbm_bytes_limit": 1000}]
+    assert prune.hbm_model_for("trivial", rows).source == "measured"
+    assert prune.hbm_model_for("trivial", None).source == "seeded"
+    assert prune.hbm_model_for("trivial", [{"overrides": {}}]
+                               ).source == "seeded"
+    # a member outside the seed table with no measurements: no model
+    assert prune.hbm_model_for("not_a_member", None) is None
+
+
+def test_measured_anchor_keeps_seed_bf16_fact():
+    """The f32-accumulator rejection is a state-memory fact from the
+    seed; switching the microbatch anchor to measured provenance must
+    not drop it."""
+    bf16_members = [name for name, seed in prune.SEED_CONFIGS.items()
+                    if seed.get("accum_dtype") == "bf16"]
+    if not bf16_members:
+        pytest.skip("no seed carries accum_dtype=bf16")
+    member = bf16_members[0]
+    seeded = prune.HbmModel.seeded(member)
+    rows = [{"overrides": {"batch_size": 4},
+             "peak_hbm_bytes": 100, "hbm_bytes_limit": 1000}]
+    m = prune.hbm_model_for(member, rows)
+    assert m.source == "measured"
+    assert m.needs_bf16_accum_at == seeded.needs_bf16_accum_at
+    # OOM rows classify through the ONE spelling list (obs.memory)
+    assert prune._row_oomed({"error": "Out of memory: 1 GiB"})
+    assert prune._row_oomed({"error": "skipped: hbm-oom"})
+    assert not prune._row_oomed({"error": "segfault"})
+
+
+def test_static_prune_journals_hbm_source():
+    big = space.Candidate.make("trivial", {"batch_size": 4096})
+    res = prune.static_prune([big])
+    skips = [s for s in res.skipped if s.cls == prune.HBM_OOM]
+    assert skips and skips[0].hbm_source == "seeded"
+    assert skips[0].journal_record()["hbm_source"] == "seeded"
+    # with a measured row that says even 64 barely fits, provenance flips
+    rows = [{"overrides": {"batch_size": 64},
+             "peak_hbm_bytes": 990, "hbm_bytes_limit": 1000}]
+    res2 = prune.static_prune(
+        [space.Candidate.make("trivial", {"batch_size": 512})],
+        measured_rows=rows)
+    skips2 = [s for s in res2.skipped if s.cls == prune.HBM_OOM]
+    assert skips2 and skips2[0].hbm_source == "measured"
+    assert "measured HBM anchor" in skips2[0].reason
+    # non-hbm skips carry no provenance field
+    assert "hbm_source" not in prune.Skip(
+        big, prune.LINT, "x").journal_record()
+
+
+def test_search_measured_recheck_skips_mid_search(tmp_path):
+    """The closed loop: candidate A's measurement journals a peak near
+    the device limit, so candidate B (a larger microbatch the SEEDED
+    anchor admitted) is skipped without a run, hbm_source=measured."""
+    cands = [space.Candidate.make("trivial", {"batch_size": 64}),
+             space.Candidate.make("trivial", {"batch_size": 512})]
+    calls: list = []
+
+    def stub(c, rung, batches):
+        calls.append((c.key, rung))
+        return {"per_chip": 100.0, "wall_s": 1.0,
+                "peak_hbm_bytes": 950, "hbm_bytes_limit": 1000,
+                "mem_source": "memory_stats"}
+
+    j = search.run_search(
+        "trivial", str(tmp_path), "cpu-test-w1",
+        settings=search.SearchSettings(budget_s=1e9, max_rungs=1),
+        runner=stub, space=cands, print_fn=lambda m: None)
+    # batch 512 never ran: the measured anchor (~64·1000/950·1.15 ≈ 58,
+    # floored at the measured-OK 64) rejected it mid-rung
+    assert all(k == "batch_size=64" for k, _ in calls)
+    skips = [s for s in j["skipped"]
+             if s["class"] == prune.HBM_OOM
+             and s.get("hbm_source") == "measured"]
+    assert skips and skips[0]["key"] == "batch_size=512"
+    assert j["best"]["key"] == "batch_size=64"
+    # the journal measurement row carries the memory it recorded
+    row = j["measurements"]["batch_size=64"]["0"]
+    assert row["peak_hbm_bytes"] == 950
+    assert row["hbm_bytes_limit"] == 1000
+
+
+def test_tune_show_journal_renders_prune_ledger(tmp_path, capsys):
+    journal = {
+        "model": "trivial", "hardware": "cpu-test-w1",
+        "status": "complete", "spent_s": 12.0, "budget_s": 600.0,
+        "skipped": [
+            {"key": "batch_size=4096", "class": "hbm-oom",
+             "hbm_source": "measured",
+             "reason": "microbatch 4096 exceeds the measured HBM "
+                       "anchor 64 x headroom 1 = 64"},
+            {"key": "accum=0", "class": "flag-invalid", "reason": "x"},
+        ],
+        "candidates": {"batch_size=64": {"overrides":
+                                         {"batch_size": 64}}},
+        "measurements": {"batch_size=64": {
+            "0": {"per_chip": 100.0, "peak_hbm_bytes": 950 << 20,
+                  "hbm_bytes_limit": 2 << 30,
+                  "mem_source": "memory_stats"}}},
+    }
+    p = tmp_path / "tune_state.json"
+    p.write_text(json.dumps(journal))
+    assert tune_main(["show", "--journal", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "pruned without a run: 2 (flag-invalid x1, hbm-oom x1)" in out
+    assert "[hbm-oom/measured] batch_size=4096" in out
+    assert "measured: batch_size=64 rung 0: peak 950.0 MiB" in out
+    assert "[memory_stats]" in out
+
+
+# ---------------------------------------------------------------------
+# the memory-probe-in-hot-loop lint
+
+
+HOT_PROBE_FIXTURE = """\
+import jax
+
+def unguarded(n):
+    out = []
+    for i in range(n):
+        out.append(jax.live_arrays())
+    return out
+
+def guarded(n, sync_every):
+    for i in range(n):
+        if i % sync_every == 0:
+            jax.live_arrays()
+
+def spelled_guard(mem_ledger, win):
+    while True:
+        if win.at_sync_boundary:
+            mem_ledger.sample("step")
+
+def header_only():
+    total = 0
+    for a in jax.live_arrays():
+        total += a.nbytes
+    return total
+
+def nested_def():
+    for i in range(3):
+        def f():
+            return jax.live_arrays()
+
+def profile_loop(n):
+    while n:
+        jax.profiler.device_memory_profile()
+        n -= 1
+
+def ledger_loop(mem_ledger, n):
+    for i in range(n):
+        mem_ledger.sample("step")
+"""
+
+
+def test_memory_probe_hot_loop_lint():
+    fs = [f for f in lints.lint_source_text(HOT_PROBE_FIXTURE, "fx.py")
+          if f.lint == lints.HOT_MEMORY]
+    assert all(f.severity == "warning" for f in fs)
+    flagged = {f.location.rsplit(":", 1)[1] for f in fs}
+    # unguarded live_arrays (6), the profiler blob (32), the ledger
+    # sample (37) — and nothing else
+    assert flagged == {"6", "32", "37"}, [f.render() for f in fs]
+
+
+def test_memory_probe_lint_in_repo_gate():
+    assert lints.HOT_MEMORY in lints.ALL_SOURCE_LINTS
+
+
+# ---------------------------------------------------------------------
+# 6. end-to-end on the shared rewind_run fixture (no new driver run)
+
+
+def test_driver_memory_records_and_result(rewind_run):
+    res = rewind_run["result"]
+    # the CPU mesh has no allocator stats: the ledger degraded to the
+    # labeled live_arrays byte-sum high water, and said so
+    assert res.mem_source == "live_arrays"
+    assert res.peak_hbm_bytes and res.peak_hbm_bytes > 0
+    assert res.hbm_bytes_limit is None
+    # the AOT memory analysis of the actual step program landed
+    assert res.memory_analysis and res.memory_analysis["argument_bytes"] > 0
+    recs = obs_metrics.read_run(rewind_run["dir"])[1]
+    mem_recs = [r for r in recs if r.get("kind") == "memory"]
+    # one compile-phase sample + one per sync window + the final sample
+    assert {r["phase"] for r in mem_recs} >= {"compile", "step"}
+    assert all(r["source"] == "live_arrays" for r in mem_recs)
+    rep = [r for r in recs if r.get("kind") == "memory_report"]
+    assert rep and rep[-1]["measured"]["argument_bytes"] > 0
+    assert rep[-1]["analytic"]["params_bytes"] > 0
+
+
+def test_driver_prints_memory_lines(rewind_run):
+    text = "\n".join(rewind_run["out"])
+    assert "memory: peak" in text and "live_arrays" in text
+    assert "memory (AOT): args" in text
+
+
+def test_summarize_memory_section(rewind_run):
+    out = io.StringIO()
+    assert obs_main(["summarize", rewind_run["dir"]], out=out) == 0
+    text = out.getvalue()
+    assert "memory: peak" in text
+    assert "per-phase peaks (MiB):" in text
+    assert "compile" in text and "memory (AOT): args" in text
+
+
+def test_diff_memory_rows(rewind_run):
+    out = io.StringIO()
+    assert obs_main(["diff", rewind_run["dir"], rewind_run["dir"]],
+                    out=out) == 0
+    text = out.getvalue()
+    assert "peak HBM MiB" in text
+    assert "aot args MiB" in text and "aot temp MiB" in text
+
+
+def test_heartbeat_carries_unified_mem_peak(rewind_run):
+    beats = fleet.read_heartbeats(rewind_run["dir"])
+    last = beats[0][-1]
+    assert fleet.heartbeat_mem_peak(last) == last["mem_peak_bytes"] > 0
+    assert "peak_bytes_in_use" not in last
+
+
+def test_watch_renders_memory(rewind_run):
+    buf = io.StringIO()
+    assert watch_mod.watch(rewind_run["dir"], out=buf,
+                           interval=0.01) == 0
+    text = buf.getvalue()
+    assert "memory: peak" in text
+    assert "mem peak" in text       # the heartbeat headline field
+
+
+def test_summary_record_carries_memory_fields(rewind_run):
+    recs = obs_metrics.read_run(rewind_run["dir"])[1]
+    summary = [r for r in recs if r.get("kind") == "summary"][-1]
+    assert summary["peak_hbm_bytes"] > 0
+    assert summary["mem_source"] == "live_arrays"
+    assert summary["memory_analysis"]["argument_bytes"] > 0
+
+
+@pytest.mark.slow
+def test_emergency_save_writes_memory_dump_subprocess(tmp_path):
+    """The forensics proof: an injected preemption exits with the
+    preemption code AND leaves ``memory_dump.json`` beside the metrics
+    stream, with the dump journaled as a ``memory_dump`` record."""
+    from tpu_hc_bench import resilience
+
+    mdir = str(tmp_path / "m")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_hc_bench", "1", "0", "2", "ici",
+         "--model", "trivial", "--num_classes", "10",
+         "--num_warmup_batches", "1", "--num_batches", "6",
+         "--display_every", "2", "--virtual_devices", "8",
+         "--inject_fault", "sigterm@2",
+         "--train_dir", str(tmp_path / "ck"), "--metrics_dir", mdir],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == resilience.EXIT_PREEMPTED, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "memory forensics (emergency_save)" in proc.stdout
+    dump = json.loads(
+        (Path(mdir) / mem.MEMORY_DUMP_NAME).read_text())
+    assert dump["reason"] == "emergency_save"
+    assert dump["total_live_bytes"] > 0 and dump["top_buffers"]
+    recs = [json.loads(ln) for ln
+            in (Path(mdir) / "metrics.jsonl").read_text().splitlines()
+            if ln.strip()]
+    drec = [r for r in recs if r.get("kind") == "memory_dump"]
+    assert drec and drec[-1]["reason"] == "emergency_save"
+    # the emergency path also sampled the ledger under its own phase
+    assert any(r.get("kind") == "memory"
+               and r.get("phase") == "emergency_save" for r in recs)

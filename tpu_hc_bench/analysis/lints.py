@@ -36,6 +36,19 @@ shipped) are checked statically:
   full-host-width *private* pool — at workers-per-host > 1 the
   per-process pools oversubscribe the CPUs and bypass the shared input
   service's one-pool-per-host budget (``data/service.py``).
+- **memory-probe-in-hot-loop** (warning): a device-memory probe
+  (``jax.live_arrays``, ``jax.profiler.device_memory_profile``,
+  ``obs.memory.device_memory_sample``/``device_memory_stats``, a
+  memory ledger's ``.sample``) called in the body of a loop without a
+  sync-window boundary guard.  Every one of these walks the backend's
+  live-buffer table (or serializes a pprof blob) on the host — inside
+  the timed step loop that is a per-step host stall the async-dispatch
+  design exists to avoid.  The accepted idiom is the driver's: one poll
+  per sync window, under an ``i % sync_every == 0``-shaped guard (any
+  modulo test, or a condition spelling ``sync``/``window``).  The check
+  is lexical — a probe wrapped in a helper called from the loop is on
+  the reviewer — and loop headers (``for a in jax.live_arrays():``,
+  the probes' own implementation) are exempt.
 - **sharding-consistency** (warning): per model, the Megatron
   annotation table (``train.step.tp_param_spec``) is replayed against
   the abstractly-initialized param tree: a rule whose *name* matches a
@@ -72,8 +85,9 @@ COLLECTIVE_SHAPE = "collective-shape"
 CKPT_TOPOLOGY = "checkpoint-topology"
 INPUT_POOL = "input-pool-width"
 TUNED_STALENESS = "tuned-config-staleness"
+HOT_MEMORY = "memory-probe-in-hot-loop"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
-                    INPUT_POOL)
+                    INPUT_POOL, HOT_MEMORY)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -560,6 +574,81 @@ class _FileLinter:
             for n in ast.walk(node))
         return has_cpu and not divided
 
+    # -- pass: memory probes inside the hot loop -----------------------
+
+    # host-stalling device-memory probe callees (obs.memory + the raw
+    # jax surfaces they wrap)
+    _MEMORY_PROBE_CALLEES = {"live_arrays", "device_memory_profile",
+                             "device_memory_sample", "device_memory_stats",
+                             "live_buffer_breakdown"}
+
+    def _check_memory_probe_hot_loop(self):
+        """A device-memory probe in a loop body must sit behind a
+        sync-window boundary guard (a modulo test, or a condition
+        spelling ``sync``/``window``) — the driver's one-poll-per-window
+        contract.  Loop headers and probes inside nested function defs
+        (executed on call, not per iteration) are exempt."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            base = name.rsplit(".", 1)[-1]
+            probe = (base in self._MEMORY_PROBE_CALLEES
+                     or (base == "sample" and "mem" in name.lower()))
+            if not probe:
+                continue
+            loop = self._enclosing_loop_body(node)
+            if loop is None or self._window_guarded(node, loop):
+                continue
+            self._emit(
+                HOT_MEMORY, "warning", node,
+                f"device-memory probe `{name}(...)` inside a loop body "
+                "without a sync-window boundary guard — each call walks "
+                "the live-buffer table on the host, a per-iteration "
+                "stall in what may be the timed step loop; poll once "
+                "per sync window (`i % sync_every == 0`) like the "
+                "driver's HBM ledger, or move the probe out of the loop")
+
+    def _enclosing_loop_body(self, node: ast.AST) -> ast.AST | None:
+        """The nearest For/While whose BODY contains ``node`` — None
+        when the walk first crosses a function boundary (a nested def's
+        body runs on call, not per iteration) or when ``node`` only
+        appears in a loop's header (`for a in probe():`)."""
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return None
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                header = (cur.test if isinstance(cur, ast.While)
+                          else cur.iter)
+                if not any(n is node for n in ast.walk(header)):
+                    return cur
+            cur = self._parents.get(cur)
+        return None
+
+    def _window_guarded(self, node: ast.AST, loop: ast.AST) -> bool:
+        cur = self._parents.get(node)
+        while cur is not None and cur is not loop:
+            if isinstance(cur, ast.If) and self._boundary_test(cur.test):
+                return True
+            cur = self._parents.get(cur)
+        return False
+
+    @staticmethod
+    def _boundary_test(test: ast.AST) -> bool:
+        for n in ast.walk(test):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod):
+                return True
+            spelled = None
+            if isinstance(n, ast.Name):
+                spelled = n.id
+            elif isinstance(n, ast.Attribute):
+                spelled = n.attr
+            if spelled and ("sync" in spelled or "window" in spelled):
+                return True
+        return False
+
     # -- driver --------------------------------------------------------
 
     def run(self) -> list[Finding]:
@@ -569,6 +658,7 @@ class _FileLinter:
         self._check_donation()
         self._check_checkpoint_topology()
         self._check_input_pool()
+        self._check_memory_probe_hot_loop()
         return self.findings
 
 

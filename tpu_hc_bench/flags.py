@@ -194,6 +194,19 @@ class BenchmarkConfig:
                                               # run judges its achieved
                                               # collective bandwidth against
                                               # this ceiling (obs.efficiency)
+    hbm_budget: str | None = None             # device-memory budget for the
+                                              # pre-run AOT check
+                                              # (obs.memory): bytes with an
+                                              # optional KB/MB/GB suffix, or
+                                              # "auto" = the live device's
+                                              # measured bytes_limit.  The
+                                              # step program's
+                                              # memory_analysis() is
+                                              # compared at run start and a
+                                              # loud WARNING fires when it
+                                              # exceeds the budget — before
+                                              # the full run's compile is
+                                              # paid for.  unset = off
     num_slices: int = 0                       # fabric=dcn multislice layout:
                                               # slices x hosts/slice x chips
                                               # (0 = one slice per host)
@@ -715,6 +728,11 @@ class BenchmarkConfig:
         # --compile_cache stays filesystem-pure here (same principle as
         # --fabric_ceiling): the driver resolves auto/off and creates the
         # directory at run start
+        if self.hbm_budget is not None:
+            from tpu_hc_bench.obs.memory import parse_hbm_budget
+
+            parse_hbm_budget(self.hbm_budget)   # loud format check;
+            # "auto" resolves against the live device at run start
         if self.step_timeout_s is not None:
             from tpu_hc_bench.resilience.watchdog import resolve_timeout
 
@@ -922,6 +940,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics_dir", type=str, default=None)
     p.add_argument("--fabric_ceiling", type=str, default=None,
                    metavar="SWEEP_JSON")
+    p.add_argument("--hbm_budget", type=str, default=None,
+                   metavar="BYTES|auto")
     p.add_argument("--num_slices", type=int, default=d.num_slices)
     p.add_argument("--fused_conv", type=_parse_bool, default=d.fused_conv)
     p.add_argument("--fused_xent", type=_parse_bool, default=False)

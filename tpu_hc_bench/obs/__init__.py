@@ -25,6 +25,12 @@ Where ``analysis`` inspects the *compiled program* (HLO, jaxpr),
   the actual step program, source-labeled against the analytic table)
   and achieved-collective-bandwidth attribution against a measured
   fabric ceiling (``microbench.osu --json`` sweeps).
+- ``obs.memory`` — measured device memory: the AOT
+  ``compiled.memory_analysis()`` report cross-checked against an
+  analytic params+opt+batch table, a per-sync-window HBM ledger whose
+  high-water mark is attributed to the goodput phase that set it, OOM/
+  emergency forensics (``memory_dump.json``), and the ``--hbm_budget``
+  pre-run check.
 - ``python -m tpu_hc_bench.obs`` — ``summarize`` renders either
   artifact kind (a metrics run or a raw trace directory); ``diff``
   compares two runs at bucket/metric granularity, so a regression

@@ -106,10 +106,16 @@ def _probe_handles(step_fn, example_args):
         return None
 
 
-def _lowered_flops(jitted, abstract) -> float | None:
+def _lowered_compiled(jitted, abstract):
     try:
-        compiled = jitted.lower(*abstract).compile()
+        return jitted.lower(*abstract).compile()
     except Exception:
+        return None
+
+
+def _lowered_flops(jitted, abstract) -> float | None:
+    compiled = _lowered_compiled(jitted, abstract)
+    if compiled is None:
         return None
     return flops_of_compiled(compiled)
 
@@ -128,7 +134,8 @@ def measured_step_flops(step_fn, *example_args) -> float | None:
 
 
 class StepFlopsProbe:
-    """``measured_step_flops`` on a background thread.
+    """``measured_step_flops`` (+ the AOT memory analysis) on a
+    background thread.
 
     The probe's AOT lower+compile is pure telemetry — nothing the step
     loop depends on — so billing it to the ledger's compile phase was
@@ -139,29 +146,57 @@ class StepFlopsProbe:
     with the timed loop; ``result()`` joins and returns the per-device
     FLOPs (None on any failure — same degradation contract as the
     synchronous probe).
+
+    The SAME compiled handle also answers ``memory_analysis()`` —
+    the argument/output/temp bytes of the step program (round 15,
+    ``obs.memory``): one compile serves both probes.
+
+    ``background=False`` runs the compile on the calling thread
+    instead: ``--hbm_budget`` needs the memory report BEFORE the
+    warmup pays for the full run's compile, and a budget check that
+    joins after the timed loop would defeat its purpose.
     """
 
-    def __init__(self, step_fn, *example_args):
-        import threading
-
+    def __init__(self, step_fn, *example_args, background: bool = True):
         self._flops: float | None = None
+        self._memory: dict | None = None
         self._thread = None
         handles = _probe_handles(step_fn, example_args)
         if handles is None:
             return
 
         def _run():
-            self._flops = _lowered_flops(*handles)
+            from tpu_hc_bench.obs import memory as memory_mod
+
+            compiled = _lowered_compiled(*handles)
+            if compiled is None:
+                return
+            self._flops = flops_of_compiled(compiled)
+            self._memory = memory_mod.memory_analysis_of_compiled(compiled)
+
+        if not background:
+            _run()
+            return
+        import threading
 
         self._thread = threading.Thread(
             target=_run, name="tpu-hc-bench-flops-probe", daemon=True)
         self._thread.start()
 
-    def result(self) -> float | None:
+    def _join(self) -> None:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+
+    def result(self) -> float | None:
+        self._join()
         return self._flops
+
+    def memory_analysis(self) -> dict | None:
+        """The step program's AOT byte accounting (obs.memory record
+        shape), or None where the backend has no analysis."""
+        self._join()
+        return self._memory
 
 
 def grad_allreduce_bytes(params, accum_dtype: str = "f32") -> int:

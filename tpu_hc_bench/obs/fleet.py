@@ -93,15 +93,16 @@ class FleetWriter:
         return self._f is not None
 
     def heartbeat(self, step: int, step_ewma_ms: float,
-                  mem: dict | None = None, **extra) -> None:
+                  mem_peak_bytes: int | None = None, **extra) -> None:
         if self._f is None:
             return
         rec = {"kind": "heartbeat", "host": self.process_index,
                "step": int(step), "step_ewma_ms": float(step_ewma_ms),
                "t_unix": time.time()}
-        if mem:
-            peaks = [v.get("peak_bytes_in_use", 0) for v in mem.values()]
-            rec["peak_bytes_in_use"] = max(peaks, default=0)
+        if mem_peak_bytes:
+            # the ONE heartbeat memory field name — readers
+            # (watch/summarize) consume it via heartbeat_mem_peak
+            rec["mem_peak_bytes"] = int(mem_peak_bytes)
         rec.update(extra)
         try:
             self._f.write(json.dumps(rec, default=str) + "\n")
@@ -167,6 +168,14 @@ def compute_skew(host_steps: list[int],
 
 # ---------------------------------------------------------------------
 # reading (pure file ops)
+
+
+def heartbeat_mem_peak(rec: dict) -> int | None:
+    """The heartbeat's device-memory peak, under the unified
+    ``mem_peak_bytes`` name (round 15); falls back to the pre-unification
+    ``peak_bytes_in_use`` spelling so old run dirs still render."""
+    v = rec.get("mem_peak_bytes", rec.get("peak_bytes_in_use"))
+    return int(v) if v else None
 
 
 def read_heartbeats(run_dir: str) -> dict[int, list[dict]]:
@@ -261,9 +270,13 @@ def straggler_lines(run_dir: str, records: list[dict]) -> list[str]:
             import statistics
 
             med = statistics.median(steps)
+            peaks = [p for p in (heartbeat_mem_peak(r)
+                                 for r in last.values()) if p]
             lines.append(
                 f"  heartbeats: {len(last)} host file(s), last steps "
-                f"median {med:.0f} min {min(steps)} max {max(steps)}")
+                f"median {med:.0f} min {min(steps)} max {max(steps)}"
+                + (f", mem peak max {max(peaks) / 2**20:.1f} MiB"
+                   if peaks else ""))
             laggards = [(h, r) for h, r in sorted(last.items())
                         if med - r.get("step", 0) >= 1]
             for h, r in laggards[:4]:

@@ -1,0 +1,539 @@
+"""Measured device memory: AOT report, runtime HBM ledger, OOM forensics.
+
+Device memory is the resource that actually walls the zoo (the
+accumulation members' batches exceed HBM as one-shot batches; the tune
+pruner's whole ``hbm-oom`` class exists because of it), yet until this
+module every memory fact the system acted on was a heuristic anchor.
+Three measurements replace the guesswork, each mirroring an existing
+honesty mechanism:
+
+- **Compile-time memory report** — the AOT path already used by the
+  MFU probe (``obs.efficiency.StepFlopsProbe``) also asks
+  ``compiled.memory_analysis()`` for the argument/output/temp/
+  generated-code bytes of the *exact step program the run executes*.
+  ``memory_report`` places the measured argument bytes next to an
+  analytic params+optimizer+batch table and flags >10% disagreement —
+  the same table-rot tripwire as the measured-vs-analytic MFU
+  cross-check.  Temp (activations + workspace) has no honest analytic
+  twin, so it is reported measured-only, never guessed.
+- **Runtime HBM ledger** — ``MemoryLedger`` polls once per sync window
+  (``device.memory_stats()`` where the backend exposes allocator
+  peaks; a ``jax.live_arrays()`` byte-sum high-water fallback on CPU,
+  which sees only sample-point live bytes, and says so via its
+  ``source`` label) and attributes the high-water mark to the goodput
+  ledger's phase that set it, so ``obs summarize`` can answer *which
+  phase* (compile, step, checkpoint_async, rewind_replay) owns the
+  peak.  One ``memory`` record per window in metrics.jsonl; the peak
+  also rides every host's fleet heartbeat as ``mem_peak_bytes``.
+- **OOM/emergency forensics** — on ``RESOURCE_EXHAUSTED``, a watchdog
+  fire, or an emergency save, ``dump_forensics`` writes a top-K
+  live-buffer breakdown (shape/dtype/count/bytes, aggregated) as
+  ``memory_dump.json`` beside the metrics stream, plus the raw
+  ``jax.profiler.device_memory_profile()`` pprof blob (which carries
+  source-line attribution) when the backend exposes it.  Best-effort
+  by construction: forensics on a dying run must never mask the death.
+
+``--hbm_budget[=auto]`` closes the pre-run gap: the AOT memory report
+is compared against the budget (``auto`` = the device's measured
+``bytes_limit``) and warns loudly at run start — before the warmup
+pays for the full run's compile and OOMs 50 steps in.
+
+The fold/render halves (``fold_memory_records``, ``memory_lines``,
+``memory_report_lines``) are pure record processing so ``summarize``/
+``diff``/``watch`` work on artifacts from any machine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+MEMORY_DUMP_NAME = "memory_dump.json"
+MEMORY_PROFILE_NAME = "memory_profile.pb"
+
+# measured-vs-analytic argument-byte disagreement threshold — the same
+# 10% contract as the MFU cross-check (obs.efficiency.mfu_report)
+ARGS_DISAGREE_FRAC = 0.10
+
+
+# ---------------------------------------------------------------------
+# compile-time: AOT memory analysis + the analytic table
+
+
+def memory_analysis_of_compiled(compiled) -> dict | None:
+    """The byte accounting of ``compiled.memory_analysis()``, tolerant
+    of cross-version shapes (CompiledMemoryStats attributes on modern
+    stacks, a plain dict elsewhere, None/raise where the backend has no
+    analysis).  ``total_bytes`` is the program's device footprint:
+    args + output + temp + generated code, minus the aliased bytes that
+    donation lets outputs share with arguments."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out: dict[str, int] = {}
+    for field, attr in (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("alias_bytes", "alias_size_in_bytes"),
+        ("generated_code_bytes", "generated_code_size_in_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        if v is None and isinstance(ma, dict):
+            v = ma.get(attr, ma.get(field))
+        if v is not None:
+            try:
+                out[field] = int(v)
+            except (TypeError, ValueError):
+                continue
+    if not out:
+        return None
+    out["total_bytes"] = max(
+        0,
+        out.get("argument_bytes", 0) + out.get("output_bytes", 0)
+        + out.get("temp_bytes", 0) + out.get("generated_code_bytes", 0)
+        - out.get("alias_bytes", 0))
+    return out
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += int(leaf.size) * getattr(leaf.dtype, "itemsize", 4)
+    return total
+
+
+def analytic_memory_table(state, batch=None) -> dict:
+    """Parameter/optimizer/input bytes from the live state's shapes —
+    the analytic half of the cross-check.  ``state`` is a TrainState
+    (or the PP ``(params, opt_state)`` tuple); the sums are pure host
+    arithmetic over shapes, no device touch.  Activations are
+    deliberately absent: they have no honest analytic twin here — the
+    AOT report's temp bytes are the measurement."""
+    params = getattr(state, "params", None)
+    opt = getattr(state, "opt_state", None)
+    if params is None and isinstance(state, (tuple, list)) and state:
+        params = state[0]
+        opt = state[1] if len(state) > 1 else None
+    if params is None:
+        params = state
+    out = {
+        "params_bytes": _tree_bytes(params),
+        "opt_bytes": _tree_bytes(opt),
+        "batch_bytes": _tree_bytes(batch),
+    }
+    out["state_bytes"] = (out["params_bytes"] + out["opt_bytes"]
+                          + out["batch_bytes"])
+    return out
+
+
+def memory_report(measured: dict | None, analytic: dict) -> dict:
+    """The honest memory record: AOT bytes source-labeled next to the
+    analytic table, with the >10% argument-byte disagreement flag.
+    The comparison pairs the AOT ``argument_bytes`` against the
+    analytic params+opt+batch sum — the two views of the same thing
+    (the step program's inputs ARE the state plus the batch)."""
+    out: dict = {"analytic": dict(analytic), "mem_source": "analytic"}
+    if measured:
+        out["measured"] = dict(measured)
+        out["mem_source"] = "measured"
+        args_analytic = analytic.get("state_bytes", 0)
+        args_measured = measured.get("argument_bytes")
+        if args_analytic > 0 and args_measured:
+            rel = abs(args_measured - args_analytic) / args_analytic
+            out["args_disagreement"] = rel
+            out["args_disagree"] = rel > ARGS_DISAGREE_FRAC
+    return out
+
+
+def _mib(n) -> str:
+    return f"{(n or 0) / 2**20:.1f}"
+
+
+def memory_report_lines(rec: dict) -> list[str]:
+    """Render a ``memory_report`` record (shared by the driver's final
+    print and ``obs summarize``), mirroring ``efficiency.mfu_lines``."""
+    if not rec:
+        return []
+    analytic = rec.get("analytic") or {}
+    measured = rec.get("measured")
+    if measured:
+        head = (f"  memory (AOT): args {_mib(measured.get('argument_bytes'))}"
+                f" MiB  temp {_mib(measured.get('temp_bytes'))} MiB  "
+                f"output {_mib(measured.get('output_bytes'))} MiB  "
+                f"total {_mib(measured.get('total_bytes'))} MiB")
+    else:
+        head = "  memory (AOT): unavailable on this arm/backend"
+    head += (f"  (analytic: params {_mib(analytic.get('params_bytes'))}"
+             f" + opt {_mib(analytic.get('opt_bytes'))}"
+             f" + batch {_mib(analytic.get('batch_bytes'))}"
+             f" = {_mib(analytic.get('state_bytes'))} MiB)")
+    lines = [head]
+    if rec.get("args_disagree"):
+        lines.append(
+            f"  WARNING: AOT argument bytes disagree "
+            f"{rec.get('args_disagreement', 0.0):.0%} with the analytic "
+            f"params+opt+batch table: measured "
+            f"{_mib((rec.get('measured') or {}).get('argument_bytes'))} vs "
+            f"analytic {_mib(analytic.get('state_bytes'))} MiB — the "
+            f"state-layout table may have rotted")
+    return lines
+
+
+# ---------------------------------------------------------------------
+# runtime: per-sync-window sampling + phase-attributed high water
+
+
+def device_memory_sample() -> dict:
+    """One capability-gated device-memory poll.
+
+    Where the backend exposes allocator stats (TPU) the sample carries
+    true per-device peaks and the HBM limit; on backends that do not
+    (the CPU test mesh) it degrades to the ``jax.live_arrays()`` byte
+    sum — the live bytes at THIS sample point, labeled ``live_arrays``
+    so no consumer mistakes it for an allocator peak."""
+    from tpu_hc_bench.obs import metrics as metrics_mod
+
+    stats = metrics_mod.device_memory_stats()
+    if stats:
+        limits = [v["bytes_limit"] for v in stats.values()
+                  if v.get("bytes_limit")]
+        return {
+            "source": "memory_stats",
+            "bytes_in_use": max((v.get("bytes_in_use", 0)
+                                 for v in stats.values()), default=0),
+            "peak_bytes": max((v.get("peak_bytes_in_use", 0)
+                               for v in stats.values()), default=0),
+            "bytes_limit": min(limits) if limits else None,
+            "devices": stats,
+        }
+    import jax
+
+    total = 0
+    try:
+        for a in jax.live_arrays():
+            total += int(getattr(a, "nbytes", 0) or 0)
+    except Exception:
+        total = 0
+    return {"source": "live_arrays", "bytes_in_use": total,
+            "peak_bytes": None, "bytes_limit": None}
+
+
+class MemoryLedger:
+    """Per-run device-memory high water, attributed to goodput phases.
+
+    The driver calls ``sample(phase, step)`` once per sync window (and
+    at checkpoint/rewind/emergency boundaries) and writes the returned
+    record into the metrics stream as one ``memory`` record.  The
+    ledger keeps the running peak and the phase during which it rose
+    (allocator peaks are process-lifetime cumulative, so "the phase
+    polled when the peak first read higher" is the honest attribution),
+    plus per-phase maxima of the *sampled in-use bytes* — attributing
+    the cumulative peak to every later phase would make the per-phase
+    table meaningless.  Under the ``live_arrays`` fallback (no
+    allocator peaks) the record's ``peak_bytes`` is the ledger's own
+    running high water, so the on-disk stream folds identically on
+    every backend.
+
+    ``sample_fn`` is injectable for deterministic tests.
+    """
+
+    def __init__(self, sample_fn=None):
+        self._sample_fn = sample_fn or device_memory_sample
+        self.peak_bytes = 0
+        self.peak_phase: str | None = None
+        self.per_phase: dict[str, int] = {}
+        self.source: str | None = None
+        self.bytes_limit: int | None = None
+
+    def sample(self, phase: str, step: int | None = None) -> dict:
+        s = dict(self._sample_fn())
+        # per-window stream records stay lean: no fold/render consumer
+        # reads the per-device table (forensics re-reads the allocator
+        # stats itself when it needs them)
+        s.pop("devices", None)
+        self.source = s.get("source") or self.source
+        if s.get("bytes_limit"):
+            self.bytes_limit = s["bytes_limit"]
+        high = s.get("peak_bytes") or s.get("bytes_in_use") or 0
+        # per-phase from the sample-point in-use bytes: the allocator
+        # peak is cumulative over the process, so using it here would
+        # stamp the global high water onto every later phase
+        usage = s.get("bytes_in_use") or high
+        self.per_phase[phase] = max(self.per_phase.get(phase, 0), usage)
+        if high > self.peak_bytes:
+            self.peak_bytes = high
+            self.peak_phase = phase
+        if not s.get("peak_bytes"):
+            # live_arrays fallback: the stream carries the running high
+            # water so offline folds see the same number the ledger does
+            s["peak_bytes"] = self.peak_bytes
+        s["phase"] = phase
+        s["step"] = step
+        return s
+
+    def fold(self) -> dict | None:
+        """The ledger's own account in ``fold_memory_records`` shape —
+        the driver's end-of-run print and the offline summarize fold
+        render through the same ``memory_lines``."""
+        if self.peak_bytes <= 0:
+            return None
+        return {"peak_bytes": self.peak_bytes,
+                "peak_phase": self.peak_phase,
+                "per_phase": dict(self.per_phase),
+                "source": self.source,
+                "bytes_limit": self.bytes_limit}
+
+
+def fold_memory_records(records: list[dict]) -> dict | None:
+    """Fold a run's ``memory`` records (pure — the ``summarize``/
+    ``diff``/``watch`` half of the ledger).  Tolerates the pre-round-15
+    record shape ({"supported": bool, "devices": {...}}, no phase)."""
+    peak = 0
+    peak_phase: str | None = None
+    per_phase: dict[str, int] = {}
+    source = None
+    limit = None
+    seen = False
+    for r in records:
+        if r.get("kind") != "memory":
+            continue
+        seen = True
+        if "bytes_in_use" in r or "peak_bytes" in r:
+            high = r.get("peak_bytes") or r.get("bytes_in_use") or 0
+            usage = r.get("bytes_in_use") or high
+            phase = r.get("phase")
+            source = r.get("source") or source
+            if r.get("bytes_limit"):
+                limit = r["bytes_limit"]
+        else:       # legacy end-of-run record
+            devices = r.get("devices") or {}
+            high = max((v.get("peak_bytes_in_use", 0)
+                        for v in devices.values()), default=0)
+            usage = high
+            phase = None
+            source = source or ("memory_stats" if devices else None)
+        if phase:
+            # sample-point usage, not the cumulative allocator peak —
+            # same attribution rule as MemoryLedger.sample
+            per_phase[phase] = max(per_phase.get(phase, 0), usage)
+        if high > peak:
+            peak, peak_phase = high, phase
+    if not seen or peak <= 0:
+        return None
+    return {"peak_bytes": peak, "peak_phase": peak_phase,
+            "per_phase": per_phase, "source": source,
+            "bytes_limit": limit}
+
+
+def memory_lines(fold: dict | None) -> list[str]:
+    """Render a ``fold_memory_records`` result (summarize/watch/driver)."""
+    if not fold:
+        return []
+    head = f"  memory: peak {_mib(fold['peak_bytes'])} MiB"
+    if fold.get("bytes_limit"):
+        head += (f" of {fold['bytes_limit'] / 2**30:.1f} GiB limit "
+                 f"({fold['peak_bytes'] / fold['bytes_limit']:.0%})")
+    head += f"  (source: {fold.get('source') or '?'}"
+    if fold.get("peak_phase"):
+        head += f"; high-water set in phase {fold['peak_phase']}"
+    head += ")"
+    lines = [head]
+    per_phase = fold.get("per_phase") or {}
+    if per_phase:
+        from tpu_hc_bench.obs import goodput as goodput_mod
+
+        order = [p for p in goodput_mod.PHASES if p in per_phase]
+        order += [p for p in per_phase if p not in order]
+        lines.append("    per-phase peaks (MiB): " + "  ".join(
+            f"{p} {_mib(per_phase[p])}" for p in order))
+    return lines
+
+
+# ---------------------------------------------------------------------
+# OOM / emergency forensics
+
+
+def is_oom_error(exc: BaseException | str) -> bool:
+    """Device-memory exhaustion, by message: jax surfaces allocator
+    failure as XlaRuntimeError('RESOURCE_EXHAUSTED: ...') with
+    'Out of memory' / 'failed to allocate' spellings across backends.
+    The ONE copy of the spellings — tune.prune's measured-anchor OOM
+    classifier calls this too (a string is accepted for that path)."""
+    msg = str(exc)
+    return any(tok in msg for tok in (
+        "RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+        "failed to allocate"))
+
+
+def live_buffer_breakdown(top_k: int = 24) -> dict:
+    """Top-K live device buffers, aggregated by (shape, dtype) — one
+    row per distinct buffer shape with count and total bytes, largest
+    first.  The aggregation is the point: an OOM'd training step holds
+    hundreds of identically-shaped activation blocks, and 'which shape
+    class owns the memory' is the actionable fact."""
+    import jax
+
+    groups: dict[tuple, dict] = {}
+    total = 0
+    count = 0
+    for a in jax.live_arrays():
+        try:
+            nbytes = int(a.nbytes)
+            key = (tuple(a.shape), str(a.dtype))
+        except Exception:
+            continue
+        count += 1
+        total += nbytes
+        g = groups.setdefault(key, {"shape": list(key[0]),
+                                    "dtype": key[1], "count": 0,
+                                    "nbytes": 0})
+        g["count"] += 1
+        g["nbytes"] += nbytes
+    top = sorted(groups.values(), key=lambda g: -g["nbytes"])[:top_k]
+    return {"total_live_bytes": total, "buffer_count": count,
+            "top_buffers": top}
+
+
+def dump_forensics(out_dir: str, reason: str, step: int | None = None,
+                   top_k: int = 24, error: str | None = None,
+                   print_fn=None) -> str | None:
+    """Write ``memory_dump.json`` beside the metrics stream.
+
+    Contents: the live-buffer breakdown, the device allocator stats
+    where available, and (when the backend exposes it) the raw
+    ``jax.profiler.device_memory_profile()`` pprof blob saved as
+    ``memory_profile.pb`` next to the dump — that blob carries the
+    per-allocation source lines (``pprof -lines memory_profile.pb``).
+    Best-effort end to end: this runs on OOM/watchdog/preemption paths
+    and must never raise over the death it is documenting.  Returns the
+    dump path, or None on any failure."""
+    try:
+        from tpu_hc_bench.obs import metrics as metrics_mod
+
+        payload: dict = {"reason": reason, "step": step,
+                         "t_unix": time.time()}
+        if error:
+            payload["error"] = str(error)[:2000]
+        payload.update(live_buffer_breakdown(top_k))
+        payload["device_memory"] = metrics_mod.device_memory_stats() or None
+        try:
+            import jax
+
+            prof = jax.profiler.device_memory_profile()
+            if prof:
+                with open(os.path.join(out_dir, MEMORY_PROFILE_NAME),
+                          "wb") as f:
+                    f.write(prof)
+                payload["device_memory_profile"] = MEMORY_PROFILE_NAME
+        except Exception:
+            pass
+        path = os.path.join(out_dir, MEMORY_DUMP_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if print_fn is not None:
+            print_fn(
+                f"memory forensics ({reason}): {path} — "
+                f"{payload['buffer_count']} live buffer(s), "
+                f"{payload['total_live_bytes'] / 2**20:.1f} MiB")
+        return path
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------
+# --hbm_budget
+
+
+_BUDGET_SUFFIXES = (
+    ("tib", 2**40), ("gib", 2**30), ("mib", 2**20), ("kib", 2**10),
+    ("tb", 2**40), ("gb", 2**30), ("mb", 2**20), ("kb", 2**10), ("b", 1),
+)
+
+
+def parse_hbm_budget(spec) -> int | str | None:
+    """``--hbm_budget`` → bytes, ``"auto"``, or None (off).
+
+    Accepts a byte count with an optional binary suffix (``16GB``,
+    ``900MB``, ``17179869184``), ``auto`` (resolve against the live
+    device's measured ``bytes_limit`` at run start), or unset/off.
+    Loud on garbage — a typo'd budget must die at flag time."""
+    if spec is None:
+        return None
+    s = str(spec).strip().lower()
+    if s in ("", "off", "none", "0"):
+        return None
+    if s == "auto":
+        return "auto"
+    mult = 1
+    for suf, m in _BUDGET_SUFFIXES:
+        if s.endswith(suf):
+            s, mult = s[: -len(suf)].strip(), m
+            break
+    try:
+        val = float(s) * mult
+    except ValueError:
+        raise ValueError(
+            f"--hbm_budget must be bytes (suffixes KB/MB/GB/TB), 'auto', "
+            f"or unset/off: {spec!r}") from None
+    if val <= 0:
+        raise ValueError(f"--hbm_budget must be > 0: {spec!r}")
+    return int(val)
+
+
+def resolve_hbm_budget_bytes(parsed) -> tuple[int | None, str | None]:
+    """Resolve a parsed budget to bytes at run start.  ``auto`` reads
+    the smallest local device's ``bytes_limit``; returns ``(None,
+    note)`` when the backend exposes none (the CPU test mesh) — the
+    caller prints the note instead of silently skipping the check."""
+    if parsed is None:
+        return None, None
+    if parsed != "auto":
+        return int(parsed), None
+    sample = device_memory_sample()
+    limit = sample.get("bytes_limit")
+    if limit:
+        return int(limit), None
+    return None, ("--hbm_budget=auto: this backend exposes no device "
+                  "bytes_limit (memory_stats unavailable) — budget "
+                  "check skipped; pass an explicit byte budget")
+
+
+def budget_lines(measured: dict | None, budget_bytes: int | None,
+                 note: str | None = None) -> list[str]:
+    """The pre-run budget verdict: loud WARNING when the AOT memory
+    report exceeds the budget, one quiet confirmation line otherwise."""
+    if note:
+        return [f"WARNING: {note}"]
+    if budget_bytes is None:
+        return []
+    if not measured or not measured.get("total_bytes"):
+        return ["WARNING: --hbm_budget: no AOT memory report for this "
+                "arm/backend — budget unchecked"]
+    total = measured["total_bytes"]
+    detail = (f"args {_mib(measured.get('argument_bytes'))} + temp "
+              f"{_mib(measured.get('temp_bytes'))} + output "
+              f"{_mib(measured.get('output_bytes'))} MiB")
+    if total > budget_bytes:
+        return [
+            f"WARNING: --hbm_budget: AOT memory report "
+            f"{total / 2**30:.2f} GiB ({detail}) EXCEEDS the budget "
+            f"{budget_bytes / 2**30:.2f} GiB — this run is likely to "
+            f"OOM; shrink --batch_size or raise "
+            f"--gradient_accumulation_steps before paying for the full "
+            f"run"]
+    return [f"hbm budget: AOT memory report {total / 2**30:.2f} GiB "
+            f"({detail}) fits the budget {budget_bytes / 2**30:.2f} GiB "
+            f"({total / budget_bytes:.0%})"]

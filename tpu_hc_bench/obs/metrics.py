@@ -10,8 +10,11 @@ until this module, our driver inherited that.  A run with
   regression hunt needs to answer "what exactly was this run?".
 - ``metrics.jsonl`` — one record per event, ``kind``-tagged:
   ``window`` (per-display-window rate/step-time/loss), ``memory``
-  (``device.memory_stats()`` peak/live bytes, where the backend
-  supports it), ``data`` (host decode-pool counters on real-data runs),
+  (one per sync window: the ``obs.memory`` HBM ledger's phase-stamped
+  device-memory sample — allocator peaks where the backend exposes
+  them, a ``live_arrays`` byte-sum high-water elsewhere),
+  ``memory_report`` (the AOT-vs-analytic compile-time byte account),
+  ``data`` (host decode-pool counters on real-data runs),
   ``trace_buckets`` (the post-run trace attribution when profiling ran),
   and a final ``summary`` (the BenchmarkResult fields).
 
@@ -414,12 +417,27 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
     # input plane (real-data runs): data_wait fraction + service ring
     # backpressure — the "is the host keeping the chips fed" line
     lines.extend(fleet_mod.input_lines(run_dir, records, ledger))
-    mem = _last(records, "memory")
-    if mem and mem.get("devices"):
-        peaks = [v.get("peak_bytes_in_use", 0)
-                 for v in mem["devices"].values()]
-        lines.append(f"  memory: peak {max(peaks) / 2**20:.1f} MiB/device "
-                     f"({len(peaks)} device(s))")
+    # measured memory (obs.memory): the runtime HBM ledger's per-phase
+    # peaks + the AOT-vs-analytic compile-time report, and any OOM/
+    # emergency forensics dump the run left behind
+    from tpu_hc_bench.obs import memory as mem_mod
+
+    lines.extend(mem_mod.memory_lines(
+        mem_mod.fold_memory_records(records)))
+    mem_rep = _last(records, "memory_report")
+    if mem_rep:
+        lines.extend(mem_mod.memory_report_lines(mem_rep))
+    budget = _last(records, "hbm_budget")
+    if budget:
+        lines.append(
+            f"  hbm budget: {'EXCEEDED' if budget.get('exceeded') else 'ok'}"
+            f" (AOT {budget.get('total_bytes', 0) / 2**30:.2f} GiB vs "
+            f"budget {budget.get('budget_bytes', 0) / 2**30:.2f} GiB)")
+    dump = _last(records, "memory_dump")
+    if dump:
+        lines.append(
+            f"  memory dump: {dump.get('path')} "
+            f"(reason {dump.get('reason')}, step {dump.get('step')})")
     resume = _last(records, "resume")
     if resume:
         # elastic-resume identity: a post-resume throughput shift with a
@@ -564,12 +582,32 @@ def diff_runs(path_a: str, path_b: str,
         lines.append("  trace buckets (device us):")
         lines.extend("  " + ln for ln in trace_mod.diff_buckets(
             tb_a["buckets"], tb_b["buckets"], label_a="a", label_b="b"))
-    mem_a, mem_b = _last(recs_a, "memory"), _last(recs_b, "memory")
-    if mem_a and mem_b and mem_a.get("devices") and mem_b.get("devices"):
-        pa = max(v.get("peak_bytes_in_use", 0)
-                 for v in mem_a["devices"].values())
-        pb = max(v.get("peak_bytes_in_use", 0)
-                 for v in mem_b["devices"].values())
+    # memory deltas (obs.memory): runtime high-water + the AOT report's
+    # byte classes — a batch/accum change shows up here as temp bytes
+    # moving while args stay flat, BEFORE anything OOMs
+    from tpu_hc_bench.obs import memory as mem_mod
+
+    fold_a = mem_mod.fold_memory_records(recs_a)
+    fold_b = mem_mod.fold_memory_records(recs_b)
+    if fold_a and fold_b:
+        pa, pb = fold_a["peak_bytes"], fold_b["peak_bytes"]
         lines.append(f"  {'peak HBM MiB':>14s} {pa / 2**20:12.1f} "
                      f"{pb / 2**20:12.1f} {_pct(pa, pb):>8s}")
+        if (fold_a.get("peak_phase") != fold_b.get("peak_phase")
+                and (fold_a.get("peak_phase") or fold_b.get("peak_phase"))):
+            lines.append(f"  note: memory high-water phase differs: "
+                         f"{fold_a.get('peak_phase') or '?'} -> "
+                         f"{fold_b.get('peak_phase') or '?'}")
+    rep_a = _last(recs_a, "memory_report") or {}
+    rep_b = _last(recs_b, "memory_report") or {}
+    ma, mb = rep_a.get("measured") or {}, rep_b.get("measured") or {}
+    if ma and mb:
+        for label, key in (("aot args MiB", "argument_bytes"),
+                           ("aot temp MiB", "temp_bytes"),
+                           ("aot out MiB", "output_bytes")):
+            va, vb = ma.get(key, 0), mb.get(key, 0)
+            if va or vb:
+                lines.append(
+                    f"  {label:>14s} {va / 2**20:12.1f} "
+                    f"{vb / 2**20:12.1f} {_pct(va, vb):>8s}")
     return lines

@@ -55,16 +55,30 @@ def render(path: str, manifest: dict, records: list[dict],
         last = max((recs[-1] for recs in beats.values() if recs),
                    key=lambda r: r.get("step", 0), default=None)
         if last is not None:
+            mem = fleet_mod.heartbeat_mem_peak(last)
             lines.append(
                 f"  step {last.get('step', '?')}"
                 + (f"/{total}" if total else "")
                 + f" (heartbeat)   step ~"
-                f"{last.get('step_ewma_ms', 0.0):.1f}ms ewma")
+                f"{last.get('step_ewma_ms', 0.0):.1f}ms ewma"
+                + (f"   mem peak {mem / 2**20:.1f} MiB" if mem else ""))
     else:
         lines.append("  (no progress records yet)")
+    # fleet memory: the heartbeat mem_peak_bytes field, max across the
+    # hosts' freshest beats (previously received and dropped)
+    mem_peaks = [p for p in (
+        fleet_mod.heartbeat_mem_peak(recs[-1])
+        for recs in beats.values() if recs) if p]
+    if len(mem_peaks) > 1:
+        lines.append(f"  fleet mem peak: {max(mem_peaks) / 2**20:.1f} MiB "
+                     f"max across {len(mem_peaks)} host(s)")
     ledger = goodput_mod.build_ledger(records)
     if ledger is not None:
         lines.extend("  " + ln for ln in ledger.format_lines())
+    from tpu_hc_bench.obs import memory as memory_mod
+
+    lines.extend(memory_mod.memory_lines(
+        memory_mod.fold_memory_records(records))[:1])
     summary = _last(records, "summary")
     if summary:
         from tpu_hc_bench.obs import efficiency as eff_mod

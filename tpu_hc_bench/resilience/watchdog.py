@@ -72,7 +72,11 @@ class Watchdog:
     last completed step, or None before the first one; the arming time
     stands in until then.  ``on_timeout`` (tests) replaces the default
     ``os._exit(EXIT_WATCHDOG)`` so the firing path is unit-testable
-    in-process.
+    in-process.  ``forensics_fn`` (the driver passes
+    ``obs.memory.dump_forensics``) runs on fire, before the metrics
+    stream closes: a hang wedged on an allocator stall looks exactly
+    like a hang wedged on a collective until the live-buffer breakdown
+    says which — best-effort, it can never mask the dump/abort.
     """
 
     def __init__(self, timeout_s: float,
@@ -81,13 +85,15 @@ class Watchdog:
                  last_record_fn: Callable[[], Any] | None = None,
                  obs_writer: Any = None,
                  on_timeout: Callable[[float], None] | None = None,
-                 poll_s: float | None = None):
+                 poll_s: float | None = None,
+                 forensics_fn: Callable[[], Any] | None = None):
         self.timeout_s = float(timeout_s)
         self._progress = progress_fn
         self._print = print_fn
         self._last_record = last_record_fn
         self._obs = obs_writer
         self._on_timeout = on_timeout
+        self._forensics = forensics_fn
         self._poll_s = poll_s or max(0.05, min(5.0, self.timeout_s / 4))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -149,6 +155,19 @@ class Watchdog:
                 if rec is not None:
                     sys.stderr.write(f"watchdog: last metrics record: "
                                      f"{rec}\n")
+            except Exception:
+                pass
+        if self._forensics is not None:
+            # bounded: the forensics walk the live-buffer table on a
+            # runtime that may be THE wedged thing — a daemon thread
+            # with a capped join keeps the abort guarantee (exit 70)
+            # even when the probe itself hangs on the runtime lock
+            try:
+                t = threading.Thread(target=self._forensics,
+                                     name="tpu-hc-bench-forensics",
+                                     daemon=True)
+                t.start()
+                t.join(timeout=10.0)
             except Exception:
                 pass
         if self._obs is not None:

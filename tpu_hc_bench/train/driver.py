@@ -25,6 +25,7 @@ from tpu_hc_bench.flags import BenchmarkConfig
 from tpu_hc_bench.obs import efficiency as obs_efficiency
 from tpu_hc_bench.obs import fleet as obs_fleet
 from tpu_hc_bench.obs import goodput as obs_goodput
+from tpu_hc_bench.obs import memory as obs_memory
 from tpu_hc_bench.obs import metrics as obs_metrics
 from tpu_hc_bench.models import create_model
 from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
@@ -88,6 +89,15 @@ class BenchmarkResult:
     # whether the elastic reshard ran — so `obs diff`/BENCH json can
     # attribute a post-resume throughput shift to the topology change
     resume: dict | None = None
+    # measured device memory (obs.memory): the run's HBM high-water mark
+    # (allocator peak where the backend exposes one, the live-array
+    # byte-sum high water otherwise — mem_source says which), the device
+    # limit, and the step program's AOT memory_analysis() byte account
+    # (None on runs where the probe didn't run)
+    peak_hbm_bytes: int | None = None
+    hbm_bytes_limit: int | None = None
+    mem_source: str | None = None
+    memory_analysis: dict | None = None
 
     def json_line(self) -> dict:
         return dataclasses.asdict(self)
@@ -730,8 +740,14 @@ def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
     print_fn("-" * 40)
     print_fn(f"eval top_1 accuracy: {correct_total / seen:.4f}")
     print_fn(f"total {units}/sec: {total_rate:.2f}")
-    mem = obs_metrics.device_memory_stats()
-    obs_writer.event("memory", supported=bool(mem), devices=mem)
+    # one end-of-run memory sample (cheap, post-timing): the forward
+    # pass's high water, capability-gated with the live-arrays fallback
+    mem_ledger = obs_memory.MemoryLedger()
+    obs_writer.event("memory",
+                     **mem_ledger.sample("step", step=cfg.num_batches))
+    result.peak_hbm_bytes = mem_ledger.peak_bytes or None
+    result.hbm_bytes_limit = mem_ledger.bytes_limit
+    result.mem_source = mem_ledger.source
     obs_writer.event("summary", eval_top_1=correct_total / seen,
                      **result.json_line())
     obs_writer.close()
@@ -753,6 +769,9 @@ def run_benchmark(
     # before warmup, not after the full run when the summary needs it
     fabric_ceiling = (obs_efficiency.load_fabric_ceiling(cfg.fabric_ceiling)
                       if cfg.fabric_ceiling else None)
+    # --hbm_budget: parse loudly now; "auto" resolves to the device's
+    # measured bytes_limit right before the pre-warmup AOT check
+    hbm_budget = obs_memory.parse_hbm_budget(cfg.hbm_budget)
     # persistent compile cache (--compile_cache): activated before
     # anything lowers, so the warmup's compiles hit (warm start) or
     # populate (cold start) it; hit/miss is measured over the warmup
@@ -1550,18 +1569,57 @@ def run_benchmark(
     # point, so this is deliberately not primary-gated like the main
     # stream.  Train loop only (created after the eval arms return).
     fleet_writer = obs_fleet.FleetWriter(cfg.metrics_dir)
+    # runtime HBM ledger (obs.memory): sampled once per sync window on
+    # metrics runs, plus one end-of-run sample on every run
+    mem_ledger = obs_memory.MemoryLedger()
 
     # --- warmup (includes compile; reference warmup=50, :32) ---
     # rng is folded with the step counter so dropout masks differ per step
     phases.enter("compile")
     t_compile = time.perf_counter()
     metrics = None
-    warm_batch = None
-    for w in range(max(1, cfg.num_warmup_batches)):
-        warm_batch = next(batch_iter)
-        state, metrics = train_step(state, warm_batch,
-                                    jax.random.fold_in(rng, w))
-    drain(metrics["loss"])
+    warm_batch = next(batch_iter)
+    flops_probe = None
+    probe_wanted = bool(obs_writer.enabled or cfg.fabric_ceiling
+                        or hbm_budget is not None)
+    if hbm_budget is not None:
+        # --hbm_budget: the AOT memory report must exist BEFORE the
+        # warmup pays for the full run's compile, so the probe runs
+        # SYNCHRONOUSLY here (its compiled handle also serves the MFU
+        # probe — one compile, both measurements) and the verdict
+        # prints at run start.
+        flops_probe = obs_efficiency.StepFlopsProbe(
+            train_step, state, warm_batch, rng, background=False)
+        budget_bytes, budget_note = obs_memory.resolve_hbm_budget_bytes(
+            hbm_budget)
+        mem_an = flops_probe.memory_analysis()
+        for ln in obs_memory.budget_lines(mem_an, budget_bytes,
+                                          budget_note):
+            print_fn(ln)
+        if budget_bytes is not None and mem_an:
+            obs_writer.event(
+                "hbm_budget", budget_bytes=budget_bytes,
+                total_bytes=mem_an.get("total_bytes", 0),
+                exceeded=mem_an.get("total_bytes", 0) > budget_bytes)
+    try:
+        for w in range(max(1, cfg.num_warmup_batches)):
+            if w:
+                warm_batch = next(batch_iter)
+            state, metrics = train_step(state, warm_batch,
+                                        jax.random.fold_in(rng, w))
+        drain(metrics["loss"])
+    except BaseException as e:
+        # OOM forensics: the warmup (first full materialization of the
+        # step's activations) is where memory walls actually hit
+        if obs_memory.is_oom_error(e) and cfg.metrics_dir:
+            dpath = obs_memory.dump_forensics(
+                cfg.metrics_dir, reason="oom", error=str(e),
+                print_fn=print_fn)
+            if dpath:
+                obs_writer.event("memory_dump",
+                                 path=os.path.basename(dpath),
+                                 reason="oom")
+        raise
     warmup_elapsed = time.perf_counter() - t_compile
     print_fn(
         f"warmup done: {cfg.num_warmup_batches} steps in "
@@ -1593,14 +1651,23 @@ def run_benchmark(
     # thread (pure telemetry — nothing the loop depends on), so its
     # lower+compile overlaps the timed loop instead of sitting in the
     # ledger's compile phase; the result is joined after the loop.
-    flops_probe = None
-    if obs_writer.enabled or cfg.fabric_ceiling:
+    # (--hbm_budget runs already created it synchronously pre-warmup.)
+    if flops_probe is None and probe_wanted:
         flops_probe = obs_efficiency.StepFlopsProbe(
             train_step, state, warm_batch, rng)
+    # analytic memory table (obs.memory): params/opt/batch bytes from
+    # the live shapes — pure host arithmetic, computed while the warmup
+    # batch is still referenced; the post-run memory_report pairs it
+    # with the probe's AOT byte account
+    analytic_mem = obs_memory.analytic_memory_table(state, warm_batch)
     # drop the reference NOW: the probe only needed shapes, and holding
     # the last warmup batch through the timed run would pin one extra
     # device batch in HBM (max_inflight exists because batch HBM matters)
     warm_batch = None
+    if cfg.metrics_dir:
+        # the compile phase's memory high water (the warmup materialized
+        # the step program's buffers for the first time)
+        obs_writer.event("memory", **mem_ledger.sample("compile"))
 
     # --- timed loop (reference num_batches=100, display_every=10) ---
     # Fully asynchronous dispatch: the main thread never syncs, so the
@@ -1708,6 +1775,11 @@ def run_benchmark(
                          f"({time.monotonic() - t_snap:.3f}s blocking; "
                          f"write overlapped)")
             finally:
+                if cfg.metrics_dir:
+                    # the snapshot's host copy of the full state is the
+                    # phase's memory signature — attribute it
+                    obs_writer.event("memory", **mem_ledger.sample(
+                        "checkpoint_async", step=i))
                 phases.enter("step", step=i)
                 if dog is not None:
                     dog.resume()
@@ -1756,6 +1828,9 @@ def run_benchmark(
                                         print_fn=print_fn,
                                         writer=async_ckpt)
         finally:
+            if cfg.metrics_dir:
+                obs_writer.event("memory", **mem_ledger.sample(
+                    phase, step=i))
             phases.enter("step", step=i)
             if dog is not None:
                 dog.resume()
@@ -1789,6 +1864,19 @@ def run_benchmark(
                     state.params if hasattr(state, "params") else state[0],
                     print_fn)
             obs_writer.event("emergency_ckpt", step=completed)
+        if cfg.metrics_dir:
+            # emergency forensics (obs.memory): what the devices held
+            # when the run was killed — written BEFORE the streams
+            # close, best-effort so it can never mask the preemption
+            obs_writer.event("memory", **mem_ledger.sample(
+                "emergency_save", step=completed))
+            dpath = obs_memory.dump_forensics(
+                cfg.metrics_dir, reason="emergency_save", step=completed,
+                print_fn=print_fn)
+            if dpath:
+                obs_writer.event("memory_dump",
+                                 path=os.path.basename(dpath),
+                                 reason="emergency_save", step=completed)
         obs_writer.event("preempt", step=completed,
                          signal=preempt_h.signum, checkpoint_saved=saved,
                          world=topo_rec.get("world"),
@@ -1934,7 +2022,12 @@ def run_benchmark(
                 timeout_s, lambda: timeline.fetcher.last_arrival_t,
                 print_fn=print_fn,
                 last_record_fn=lambda: obs_writer.last_record,
-                obs_writer=obs_writer).start()
+                obs_writer=obs_writer,
+                forensics_fn=(
+                    (lambda: obs_memory.dump_forensics(
+                        cfg.metrics_dir, reason="watchdog",
+                        print_fn=print_fn))
+                    if cfg.metrics_dir else None)).start()
             print_fn(f"watchdog armed: step timeout {timeout_s:.1f}s")
         if policy == "rewind":
             from tpu_hc_bench.utils import checkpoint as ckpt_mod
@@ -1998,6 +2091,12 @@ def run_benchmark(
                 if cfg.metrics_dir:
                     hb_step = timeline.fetcher.fetched_step
                     ewma_ms = hb_ewma.update(hb_step)
+                    # HBM ledger (obs.memory): ONE device-memory poll
+                    # per sync window, phase-attributed, written as one
+                    # `memory` record; the running peak rides this
+                    # host's heartbeat under the unified name
+                    obs_writer.event("memory",
+                                     **mem_ledger.sample("step", step=i))
                     # input-service backpressure rides the heartbeat:
                     # ring occupancy now + consumer-wait delta this
                     # window, so a starved host is visible fleet-wide
@@ -2005,7 +2104,7 @@ def run_benchmark(
                                 if svc_client is not None else {})
                     fleet_writer.heartbeat(
                         step=hb_step, step_ewma_ms=ewma_ms,
-                        mem=obs_metrics.device_memory_stats(),
+                        mem_peak_bytes=mem_ledger.peak_bytes or None,
                         **hb_input)
                     if world > 1:
                         skew = obs_fleet.straggler_gather(hb_step, ewma_ms)
@@ -2167,8 +2266,20 @@ def run_benchmark(
         svc_client.close()
     if input_svc is not None:
         input_svc.stop()
-    mem = obs_metrics.device_memory_stats()
-    obs_writer.event("memory", supported=bool(mem), devices=mem)
+    # final memory sample + the compile-time report (obs.memory): the
+    # ledger's high water and its phase ride the summary; the AOT
+    # memory_analysis() byte account is cross-checked against the
+    # analytic params+opt+batch table (same 10% tripwire as MFU)
+    obs_writer.event("memory",
+                     **mem_ledger.sample("step", step=cfg.num_batches))
+    mem_an = (flops_probe.memory_analysis()
+              if flops_probe is not None else None)
+    mem_rep = obs_memory.memory_report(mem_an, analytic_mem)
+    obs_writer.event("memory_report", **mem_rep)
+    result.peak_hbm_bytes = mem_ledger.peak_bytes or None
+    result.hbm_bytes_limit = mem_ledger.bytes_limit
+    result.mem_source = mem_ledger.source
+    result.memory_analysis = mem_an
     # gradient-allreduce wire bytes (the dominant collective): what the
     # fabric-ceiling attribution divides by.  DP/SP/TP psum+GSPMD arms
     # only — PP's pipeline and the host fabric reduce differently.
@@ -2201,6 +2312,14 @@ def run_benchmark(
     if ledger is not None:
         for ln in ledger.format_lines():
             print_fn(ln)
+    for ln in obs_memory.memory_lines(mem_ledger.fold()):
+        print_fn(ln.strip())
+    if probe_wanted or mem_an:
+        # bare runs never created the probe — printing the report's
+        # "unavailable on this arm/backend" head there would blame a
+        # backend that was simply never asked
+        for ln in obs_memory.memory_report_lines(mem_rep):
+            print_fn(ln.strip())
     if fabric_ceiling is not None:
         for ln in obs_efficiency.ceiling_utilization_lines(
                 summary_fields, trace_rec, fabric_ceiling):

@@ -72,9 +72,54 @@ def _cmd_search(args) -> int:
     return rc
 
 
+def _render_journal(journal: dict) -> None:
+    """One search journal's prune ledger: what the pruner skipped, why,
+    and — for the hbm-oom class — which anchor provenance decided it
+    (``measured`` journal rows vs the ``seeded`` best-known-config
+    guess), plus the memory each landed measurement recorded."""
+    print(f"search journal: {journal.get('model')} @ "
+          f"{journal.get('hardware')} (status {journal.get('status')}, "
+          f"{journal.get('spent_s', 0):.0f}s/"
+          f"{journal.get('budget_s', 0):.0f}s budget)")
+    skipped = journal.get("skipped") or []
+    by_class: dict[str, int] = {}
+    for s in skipped:
+        by_class[s.get("class", "?")] = by_class.get(
+            s.get("class", "?"), 0) + 1
+    pruned = ", ".join(f"{k} x{v}" for k, v in sorted(by_class.items()))
+    print(f"  pruned without a run: {len(skipped)}"
+          + (f" ({pruned})" if pruned else ""))
+    for s in skipped:
+        if s.get("class") != "hbm-oom":
+            continue
+        print(f"    [hbm-oom/{s.get('hbm_source', '?')}] "
+              f"{s.get('key')}: {s.get('reason')}")
+    for key, meas in sorted((journal.get("measurements") or {}).items()):
+        for rung, rec in sorted((meas or {}).items()):
+            if not isinstance(rec, dict):
+                continue
+            peak = rec.get("peak_hbm_bytes")
+            if not peak:
+                continue
+            limit = rec.get("hbm_bytes_limit")
+            print(f"  measured: {key} rung {rung}: peak "
+                  f"{peak / 2**20:.1f} MiB"
+                  + (f" of {limit / 2**30:.1f} GiB "
+                     f"({peak / limit:.0%})" if limit else "")
+                  + (f" [{rec['mem_source']}]"
+                     if rec.get("mem_source") else ""))
+
+
 def _cmd_show(args) -> int:
+    import json as json_mod
+
     from tpu_hc_bench.tune import registry as registry_mod
 
+    if getattr(args, "journal", None):
+        with open(args.journal) as f:
+            journal = json_mod.load(f)
+        _render_journal(journal)
+        return 0
     hardware = args.hardware or registry_mod.hardware_key()
     rows = registry_mod.load_rows(hardware, args.registry)
     path = registry_mod.registry_path(hardware, args.registry)
@@ -140,9 +185,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="skip the per-member analysis-lint prune pass")
     s.set_defaults(fn=_cmd_search)
 
-    s = sub.add_parser("show", help="render the registry rows")
+    s = sub.add_parser("show", help="render the registry rows, or a "
+                                    "search journal's prune ledger")
     s.add_argument("--hardware", default=None)
     s.add_argument("--registry", default=None)
+    s.add_argument("--journal", default=None,
+                   help="path to a search's tune_state.json: print what "
+                        "the pruner skipped and why (hbm-oom skips carry "
+                        "their anchor provenance, measured|seeded) plus "
+                        "each measurement's recorded HBM peak")
     s.set_defaults(fn=_cmd_show)
 
     s = sub.add_parser("promote",

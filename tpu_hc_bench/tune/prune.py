@@ -13,15 +13,25 @@ bought:
   checked-in baseline.  Evaluated once per member and cached — a member
   whose step program is statically broken skips its whole candidate
   class.
-- ``hbm-oom`` — a small HBM occupancy model seeded from the best-known
-  configs (``tune.space.SEED_CONFIGS``, the machine form of the
-  BASELINE zoo table): the seeded (batch, accum) pairing is the
-  measured operating point near the HBM ceiling, so a candidate whose
+- ``hbm-oom`` — a small HBM occupancy model: a candidate whose
   *microbatch* (batch / accum — the activation-memory unit the chip
-  actually holds) exceeds that anchor by more than ``headroom`` is a
-  known-OOM skip, and a member whose seed NEEDED the bf16 accumulator
-  rejects f32-accumulator candidates at or above the seeded batch (the
-  f32 grad tree is the thing that OOMed).
+  actually holds) exceeds the model's anchor is a known-OOM skip.
+  The anchor comes from one of two provenances, journaled per skip as
+  ``hbm_source``:
+
+  - ``measured`` (preferred): prior measurements — ``tune/runner``
+    records every run's HBM high water + device limit (``obs.memory``)
+    into the journal, and ``HbmModel.from_measurements`` extrapolates
+    the largest microbatch the measured limit can hold (an OOM'd
+    measurement caps the anchor below its own microbatch).
+  - ``seeded`` (fallback): the best-known configs
+    (``tune.space.SEED_CONFIGS``, the machine form of the BASELINE zoo
+    table) — the seeded (batch, accum) pairing is assumed to sit near
+    the HBM ceiling, with ``headroom`` slack, and a member whose seed
+    NEEDED the bf16 accumulator rejects f32-accumulator candidates at
+    or above the seeded batch (the f32 grad tree is the thing that
+    OOMed).  Every memory fact here is a heuristic anchor — which is
+    why measured rows win whenever they exist.
 """
 
 from __future__ import annotations
@@ -33,7 +43,8 @@ from typing import Callable
 from tpu_hc_bench.tune.space import Candidate, SEED_CONFIGS, seed_candidate
 
 __all__ = ["Skip", "PruneResult", "HbmModel", "static_prune",
-           "baseline_lint_classes"]
+           "baseline_lint_classes", "hbm_model_for",
+           "measured_rows_from_journal"]
 
 FLAG_INVALID = "flag-invalid"
 LINT = "lint"
@@ -45,10 +56,14 @@ class Skip:
     candidate: Candidate
     cls: str        # flag-invalid | lint | hbm-oom
     reason: str
+    hbm_source: str | None = None   # hbm-oom only: measured | seeded
 
     def journal_record(self) -> dict:
-        return {"key": self.candidate.key, "class": self.cls,
-                "reason": self.reason}
+        rec = {"key": self.candidate.key, "class": self.cls,
+               "reason": self.reason}
+        if self.hbm_source is not None:
+            rec["hbm_source"] = self.hbm_source
+        return rec
 
 
 @dataclasses.dataclass
@@ -65,15 +80,19 @@ class PruneResult:
 class HbmModel:
     """Known-OOM rejection seeded from a member's best-known config.
 
-    ``max_microbatch`` is the seeded batch/accum — the measured
-    activation-memory operating point; ``needs_bf16_accum_at`` is the
-    seeded batch when the seed carries ``accum_dtype=bf16`` (meaning
-    the f32 accumulator tree is what OOMed there, BASELINE.md round 5).
+    ``max_microbatch`` is the anchor microbatch; ``needs_bf16_accum_at``
+    is the seeded batch when the seed carries ``accum_dtype=bf16``
+    (meaning the f32 accumulator tree is what OOMed there, BASELINE.md
+    round 5).  ``source`` is the anchor's provenance — ``seeded`` (a
+    best-known-config guess with ``headroom`` slack) or ``measured``
+    (extrapolated from journaled HBM measurements, ``obs.memory``) —
+    and is journaled with every hbm-oom skip.
     """
 
     max_microbatch: int
     headroom: float = 2.0
     needs_bf16_accum_at: int | None = None
+    source: str = "seeded"
 
     @staticmethod
     def seeded(model: str, headroom: float = 2.0) -> "HbmModel | None":
@@ -88,6 +107,61 @@ class HbmModel:
                         headroom=headroom,
                         needs_bf16_accum_at=bf16_at)
 
+    @staticmethod
+    def from_measurements(rows: list[dict], headroom: float = 1.15,
+                          needs_bf16_accum_at: int | None = None,
+                          ) -> "HbmModel | None":
+        """A measured anchor from journal measurement rows.
+
+        Each row is a ``tune/runner`` record (``peak_hbm_bytes`` +
+        ``hbm_bytes_limit`` from the run's ``obs.memory`` summary)
+        joined with its candidate ``overrides``.  Two signals:
+
+        - a SUCCESSFUL row extrapolates linearly: a microbatch of
+          ``m`` peaking at ``p`` bytes of an ``L``-byte device fits up
+          to ``m * L / (p * headroom)`` — the anchor takes the largest
+          such estimate (and never less than the largest microbatch
+          actually measured OK);
+        - an OOM'd row is ground truth the other way: the anchor is
+          capped strictly below that row's microbatch.
+
+        ``needs_bf16_accum_at`` rides along from the seeded model (the
+        caller grafts it): the f32-accumulator rejection is a state-
+        memory fact independent of the microbatch anchor, and a
+        measured anchor must not silently drop that skip class.
+
+        Returns None when no row carries a measurement — the caller
+        falls back to the seeded guess.
+        """
+        best_est = 0
+        oom_cap: int | None = None
+        for row in rows:
+            micro = _row_microbatch(row)
+            if micro is None:
+                continue
+            if _row_oomed(row):
+                oom_cap = micro if oom_cap is None else min(oom_cap, micro)
+                continue
+            peak = row.get("peak_hbm_bytes") or 0
+            limit = row.get("hbm_bytes_limit") or 0
+            if peak <= 0:
+                continue
+            est = micro
+            if limit > 0:
+                est = max(micro, int(micro * limit / (peak * headroom)))
+            best_est = max(best_est, est)
+        if oom_cap is not None:
+            best_est = (min(best_est, oom_cap - 1) if best_est
+                        else oom_cap - 1)
+        if best_est <= 0:
+            return None
+        # headroom=1.0: the measured anchor already IS the limit
+        # estimate — stacking the seeded model's 2x guess band on top
+        # would re-admit the OOM wall the measurement just mapped
+        return HbmModel(max_microbatch=best_est, headroom=1.0,
+                        needs_bf16_accum_at=needs_bf16_accum_at,
+                        source="measured")
+
     def check(self, c: Candidate) -> str | None:
         """A rejection reason, or None when the candidate plausibly
         fits."""
@@ -98,8 +172,9 @@ class HbmModel:
         limit = int(self.max_microbatch * self.headroom)
         if micro > limit:
             return (f"microbatch {micro} (batch {batch} / accum {accum}) "
-                    f"exceeds the seeded HBM anchor {self.max_microbatch} "
-                    f"x headroom {self.headroom:g} = {limit}")
+                    f"exceeds the {self.source} HBM anchor "
+                    f"{self.max_microbatch} x headroom "
+                    f"{self.headroom:g} = {limit}")
         if (self.needs_bf16_accum_at is not None
                 and accum > 1
                 and d.get("accum_dtype", "f32") == "f32"
@@ -108,6 +183,75 @@ class HbmModel:
                     f"config needed accum_dtype=bf16 at batch "
                     f"{self.needs_bf16_accum_at} (f32 tree OOMs)")
         return None
+
+
+# the OOM spellings live in ONE place — obs.memory.is_oom_error (the
+# forensics/warmup classifier); the pruner adds only its own journal
+# class token.  Two drifting copies would mean a new backend's OOM
+# spelling caps the forensics but not the measured anchor.
+_PRUNE_OOM_TOKENS = ("hbm-oom",)
+
+
+def _row_microbatch(row: dict) -> int | None:
+    """The activation-memory unit of a measurement row: batch / accum
+    from the candidate overrides the row was joined with."""
+    d = row.get("overrides") or {}
+    batch = int(d.get("batch_size", row.get("batch_size", 0)) or 0)
+    if batch <= 0:
+        return None
+    accum = int(d.get("gradient_accumulation_steps", 1) or 1)
+    return max(1, batch // max(1, accum))
+
+
+def _row_oomed(row: dict) -> bool:
+    from tpu_hc_bench.obs.memory import is_oom_error
+
+    err = str(row.get("error") or "")
+    return bool(err) and (is_oom_error(err)
+                          or any(tok in err for tok in _PRUNE_OOM_TOKENS))
+
+
+def measured_rows_from_journal(journal: dict,
+                               model: str | None = None) -> list[dict]:
+    """Join a search journal's measurement records with their candidate
+    overrides — the row shape ``HbmModel.from_measurements`` consumes.
+    Rows without a memory measurement AND without an OOM verdict carry
+    no information and are dropped here."""
+    rows: list[dict] = []
+    if model is not None and journal.get("model") != model:
+        return rows
+    cands = journal.get("candidates") or {}
+    for key, meas in (journal.get("measurements") or {}).items():
+        overrides = (cands.get(key) or {}).get("overrides") or {}
+        for rec in (meas or {}).values():
+            if not isinstance(rec, dict):
+                continue
+            if not (rec.get("peak_hbm_bytes") or _row_oomed(rec)):
+                continue
+            row = dict(rec)
+            row["overrides"] = dict(overrides)
+            rows.append(row)
+    return rows
+
+
+def hbm_model_for(model: str,
+                  measured_rows: list[dict] | None = None,
+                  headroom: float = 2.0) -> "HbmModel | None":
+    """The ONE place the anchor's provenance is decided: measured rows
+    win whenever they yield a model; the seeded best-known-config guess
+    is the fallback (None for members outside the seed table).  The
+    seed's ``needs_bf16_accum_at`` fact is grafted onto a measured
+    anchor — the f32-accumulator rejection is independent of the
+    microbatch anchor and must survive the provenance switch."""
+    seeded = HbmModel.seeded(model, headroom=headroom)
+    if measured_rows:
+        m = HbmModel.from_measurements(
+            measured_rows,
+            needs_bf16_accum_at=(seeded.needs_bf16_accum_at
+                                 if seeded is not None else None))
+        if m is not None:
+            return m
+    return seeded
 
 
 @functools.lru_cache(maxsize=None)
@@ -131,13 +275,17 @@ def static_prune(
     candidates: list[Candidate],
     hbm: HbmModel | None = None,
     lint_fn: Callable[[str], tuple[str, ...]] | None = None,
+    measured_rows: list[dict] | None = None,
 ) -> PruneResult:
     """Partition candidates into survivors and classed skips.
 
-    ``hbm=None`` seeds the model from the member's best-known config
-    (no-op for members outside the seed table).  ``lint_fn`` maps a
-    member name to lint-regression reasons (default: none — the CLI
-    passes ``baseline_lint_classes``; tests inject stubs).
+    ``hbm=None`` resolves the HBM model through ``hbm_model_for``:
+    measured journal rows when the caller has them, else the member's
+    best-known-config seed (no-op for members outside the seed table).
+    Every hbm-oom skip journals its anchor's provenance
+    (``hbm_source=measured|seeded``).  ``lint_fn`` maps a member name
+    to lint-regression reasons (default: none — the CLI passes
+    ``baseline_lint_classes``; tests inject stubs).
     """
     survivors: list[Candidate] = []
     skipped: list[Skip] = []
@@ -156,12 +304,14 @@ def static_prune(
             skipped.append(Skip(c, FLAG_INVALID, str(e)))
             continue
         if c.model not in hbm_by_model:
-            hbm_by_model[c.model] = (hbm if hbm is not None
-                                     else HbmModel.seeded(c.model))
+            hbm_by_model[c.model] = (
+                hbm if hbm is not None
+                else hbm_model_for(c.model, measured_rows))
         model_hbm = hbm_by_model[c.model]
         reason = model_hbm.check(c) if model_hbm is not None else None
         if reason:
-            skipped.append(Skip(c, HBM_OOM, reason))
+            skipped.append(Skip(c, HBM_OOM, reason,
+                                hbm_source=model_hbm.source))
             continue
         survivors.append(c)
     return PruneResult(survivors=survivors, skipped=skipped)

@@ -151,6 +151,21 @@ def run_one(
             # only a real fraction
             if isinstance(gp, (int, float)) and gp == gp:
                 rec["goodput"] = round(gp, 4)
+            # measured device memory (obs.memory, round 15): the run's
+            # HBM high water + the AOT byte account ride the journal so
+            # the pruner's known-OOM model can anchor on MEASUREMENT
+            # instead of the seeded guess (hbm_source=measured)
+            if summary.get("peak_hbm_bytes"):
+                rec["peak_hbm_bytes"] = int(summary["peak_hbm_bytes"])
+                rec["mem_source"] = summary.get("mem_source")
+            if summary.get("hbm_bytes_limit"):
+                rec["hbm_bytes_limit"] = int(summary["hbm_bytes_limit"])
+            ma = summary.get("memory_analysis")
+            if isinstance(ma, dict):
+                rec["memory_analysis"] = {
+                    k: ma[k] for k in ("argument_bytes", "temp_bytes",
+                                       "output_bytes", "total_bytes")
+                    if k in ma}
     if "per_chip" not in rec:
         rec["error"] = "no-throughput-line"
     return rec

@@ -218,6 +218,35 @@ def run_search(
                     break
                 c = by_key.get(key) or _candidate_from_journal(
                     model, journal, key)
+                # measured HBM re-check (obs.memory, round 15): every
+                # landed measurement journals its peak bytes / OOM
+                # verdict, so the known-OOM model re-anchors on
+                # MEASUREMENT mid-search — a candidate the seeded guess
+                # admitted is skipped for free once a measured row says
+                # it cannot fit.  Candidates with their own successful
+                # prior measurement are exempt: their row IS evidence
+                # they fit, and a contradictory anchor (mixed dtypes,
+                # a noisy limit estimate) must not retro-evict them.
+                if not any(isinstance(r, dict) and not r.get("error")
+                           for r in meas.values()):
+                    mm = prune_mod.HbmModel.from_measurements(
+                        prune_mod.measured_rows_from_journal(journal))
+                    reason = mm.check(c) if mm is not None else None
+                    if reason is not None:
+                        # journal once: a resumed session re-enters the
+                        # rung and re-derives the same verdict — the
+                        # ledger must not grow a duplicate row per resume
+                        if not any(s.get("key") == key
+                                   and s.get("class") == prune_mod.HBM_OOM
+                                   for s in journal["skipped"]):
+                            skip = prune_mod.Skip(
+                                c, prune_mod.HBM_OOM, reason,
+                                hbm_source="measured")
+                            journal["skipped"].append(skip.journal_record())
+                            commit_json(path, journal)
+                        print_fn(f"rung {rung}: {key} skipped without a "
+                                 f"run (hbm-oom, measured): {reason}")
+                        continue
                 print_fn(f"rung {rung} ({batches} steps): {key}")
                 rec = runner(c, rung, batches)
                 # provenance: how long was THIS record measured (the
