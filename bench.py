@@ -21,6 +21,69 @@ import sys
 REFERENCE_NODE_IMAGES_PER_SEC = 85.0
 
 
+def _serve_main() -> int:
+    """``BENCH_WORKLOAD=serve``: the serving-lane headline — one
+    continuous-batching run of the round-16 engine at a fixed Poisson
+    arrival rate, ONE JSON line (tokens/s + the p99/goodput SLO
+    extras).  The continuous-vs-static A/B harness is
+    ``scripts/bench_serve.py``; this entry keeps the serve headline in
+    the same BENCH_*.json trajectory as the training one.  Shares the
+    env grammar: BENCH_MODEL (a decoder/classify member),
+    BENCH_ARRIVAL, BENCH_ARRIVAL_RATE, BENCH_REQUESTS, BENCH_SERVE_BUCKETS,
+    BENCH_BATCHING, BENCH_COMPILE_CACHE, BENCH_METRICS_DIR,
+    BENCH_CONFIG=auto (resolves the <model>@serve registry row).
+    """
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.obs import metrics as obs_metrics
+    from tpu_hc_bench.serve import cli as serve_cli
+
+    cfg = flags.BenchmarkConfig(
+        model=os.environ.get("BENCH_MODEL", "moe_tiny"),
+        workload="serve",
+        config=os.environ.get("BENCH_CONFIG", "manual"),
+        arrival=os.environ.get("BENCH_ARRIVAL", "poisson"),
+        arrival_rate=float(os.environ.get("BENCH_ARRIVAL_RATE", "16")),
+        num_requests=int(os.environ.get("BENCH_REQUESTS", "48")),
+        serve_buckets=os.environ.get("BENCH_SERVE_BUCKETS", "auto"),
+        batching=os.environ.get("BENCH_BATCHING", "continuous"),
+        compile_cache=os.environ.get("BENCH_COMPILE_CACHE") or None,
+        metrics_dir=os.environ.get("BENCH_METRICS_DIR") or None,
+    ).resolve()
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    engine, requests = serve_cli.build_engine_and_requests(cfg, log)
+    summary = serve_cli.run_serve(
+        engine, requests, serve_cli.serve_writer(cfg, cfg.metrics_dir))
+    manifest = obs_metrics.run_manifest(cfg=cfg)
+    print(json.dumps({
+        "metric": f"{cfg.model}_serve_tokens_per_s",
+        "value": summary["tokens_per_s"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,    # scripts/bench_serve.py carries the A/B
+        "extra": {
+            "workload": "serve",
+            "batching": summary["batching"],
+            "arrival": cfg.arrival,
+            "arrival_rate": cfg.arrival_rate,
+            "requests": summary["requests"],
+            "completed": summary["completed"],
+            "p99_ms": summary["p99_e2e_ms"],
+            "p99_ttft_ms": summary["p99_ttft_ms"],
+            "goodput": summary["goodput"],
+            "tokens_per_s": summary["tokens_per_s"],
+            "queue_depth_max": summary["queue_depth_max"],
+            "buckets": summary["buckets"],
+            "max_in_flight": summary["max_in_flight"],
+            "kv_pages": summary["kv_pages"],
+            "kv_page_size": summary["kv_page_size"],
+            "post_warmup_compiles": summary["post_warmup_compiles"],
+            "config_source": cfg.config_source,
+            "tuned_config": cfg.tuned_config,
+        },
+        "manifest": obs_metrics.manifest_subset(manifest),
+    }))
+    return 0 if summary["completed"] > 0 else 1
+
+
 def main() -> int:
     # debug/CI escape hatch: BENCH_FORCE_CPU=1 runs the identical protocol
     # on a virtual 8-device CPU mesh (numbers meaningless, plumbing real)
@@ -30,6 +93,11 @@ def main() -> int:
 
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", 8)
+
+    # round 16: the serving lane's headline rides the same entry point
+    # (after the FORCE_CPU escape hatch so both lanes share it)
+    if os.environ.get("BENCH_WORKLOAD", "train") == "serve":
+        return _serve_main()
 
     from tpu_hc_bench import flags
     from tpu_hc_bench.obs import metrics as obs_metrics
@@ -184,12 +252,7 @@ def main() -> int:
             "config_source": cfg.config_source,
             "tuned_config": cfg.tuned_config,
         },
-        "manifest": {
-            k: manifest.get(k)
-            for k in ("git_sha", "jax_version", "jaxlib_version",
-                      "platform", "device_kind", "process_count",
-                      "device_count", "created_unix")
-        },
+        "manifest": obs_metrics.manifest_subset(manifest),
     }))
     return 0
 

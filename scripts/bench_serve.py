@@ -1,0 +1,191 @@
+"""Serving-lane A/B benchmark: continuous vs static batching at a fixed
+arrival rate.
+
+The acceptance experiment of the round-16 serving subsystem
+(``tpu_hc_bench.serve``): ONE warmed engine (every (batch, seqlen)
+bucket AOT-compiled once, through ``--compile_cache`` when given), ONE
+identical seeded request trace, TWO scheduler arms —
+
+- ``static``: the classic control — collect a full batch, run it to
+  completion, only then admit again; arrivals queue while stragglers
+  finish.
+- ``continuous``: Orca-style — admission and retirement per decode
+  step; a retired request's slot is refilled at the very next step.
+
+Both arms share the warmed AOT executables, so the A/B never pays a
+second compile and ``post_warmup_compiles`` (compile-cache entry
+deltas, the round-10 hit/miss mechanism) must stay 0 for BOTH arms.
+Emits a BENCH-style JSON record: headline ``tokens_per_s`` of the
+continuous arm, ``vs_baseline`` = continuous/static tokens/s, and
+``p99_ms``/``goodput``/``tokens_per_s`` per arm in ``extra`` — plus an
+``obs diff``-renderable pair of metrics dirs under ``--metrics_root``.
+
+Env knobs (CI parity with bench.py):
+
+- ``BENCH_MODEL`` (default moe_tiny), ``BENCH_ARRIVAL_RATE``,
+  ``BENCH_SERVE_BUCKETS``, ``BENCH_REQUESTS``, ``BENCH_MAX_IN_FLIGHT``,
+  ``BENCH_COMPILE_CACHE`` (a dir makes the zero-recompile assertion
+  measured, not vacuous).
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/bench_serve.py \
+      [--json OUT.json] [--metrics_root DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+
+def run_ab(args) -> dict:
+    from tpu_hc_bench import flags as flags_mod
+    from tpu_hc_bench.obs import metrics as obs_metrics
+    from tpu_hc_bench.serve import cli as serve_cli
+
+    cfg = flags_mod.BenchmarkConfig(
+        model=args.model,
+        workload="serve",
+        arrival=args.arrival,
+        arrival_rate=args.arrival_rate,
+        num_requests=args.num_requests,
+        serve_buckets=args.serve_buckets,
+        max_in_flight=args.max_in_flight,
+        kv_page_size=args.kv_page_size,
+        max_prompt_len=args.max_prompt_len,
+        max_output_len=args.max_output_len,
+        compile_cache=args.compile_cache,
+        seed=args.seed,
+    ).resolve()
+
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    engine, requests = serve_cli.build_engine_and_requests(cfg, log)
+
+    arms: dict[str, dict] = {}
+    for arm in ("static", "continuous"):
+        mdir = None
+        arm_cfg = cfg
+        if args.metrics_root:
+            mdir = os.path.join(args.metrics_root, arm)
+            # per-arm manifest: obs diff renders the batching flip as
+            # config drift next to the serve-metric delta rows
+            arm_cfg = flags_mod.BenchmarkConfig(
+                **{**cfg.__dict__,
+                   "translations": {}, "batching": arm,
+                   "explicit_flags": None, "tuned_config": None})
+        log(f"--- arm: {arm} ---")
+        summary = serve_cli.run_serve(
+            engine, requests, serve_cli.serve_writer(arm_cfg, mdir),
+            batching=arm)
+        arms[arm] = {
+            "tokens_per_s": summary["tokens_per_s"],
+            "p99_e2e_ms": summary["p99_e2e_ms"],
+            "p99_ttft_ms": summary["p99_ttft_ms"],
+            "p50_e2e_ms": summary["p50_e2e_ms"],
+            "goodput": summary["goodput"],
+            "queue_depth_max": summary["queue_depth_max"],
+            "wall_s": summary["wall_s"],
+            "completed": summary["completed"],
+            "post_warmup_compiles": summary["post_warmup_compiles"],
+            "metrics_dir": mdir,
+        }
+
+    st, ct = arms["static"], arms["continuous"]
+    verdict = {
+        # the two acceptance properties: continuous beats static on the
+        # p99 tail AND on goodput-under-load, at the same offered load
+        "continuous_beats_static_p99": ct["p99_e2e_ms"] < st["p99_e2e_ms"],
+        "continuous_beats_static_goodput": ct["goodput"] > st["goodput"],
+        "p99_e2e_delta_pct": round(
+            100.0 * (ct["p99_e2e_ms"] - st["p99_e2e_ms"])
+            / max(st["p99_e2e_ms"], 1e-9), 1),
+        "goodput_delta_pct": round(
+            100.0 * (ct["goodput"] - st["goodput"])
+            / max(st["goodput"], 1e-9), 1),
+        "zero_post_warmup_compiles": (
+            ct["post_warmup_compiles"] == 0
+            and st["post_warmup_compiles"] == 0),
+        "compile_cache": engine.cache_dir,
+        "compile_record": engine.compile_record,
+    }
+    manifest = obs_metrics.manifest_subset(
+        obs_metrics.run_manifest(cfg=cfg))
+    return {
+        "metric": f"{cfg.model}_serve_tokens_per_s",
+        "value": ct["tokens_per_s"],
+        "unit": "tokens/sec",
+        # continuous over the classic static arm at the same load — the
+        # serving analog of bench.py's vs-reference ratio
+        "vs_baseline": round(
+            ct["tokens_per_s"] / max(st["tokens_per_s"], 1e-9), 3),
+        "extra": {
+            "workload": "serve",
+            "model": cfg.model,
+            "arrival": cfg.arrival,
+            "arrival_rate": cfg.arrival_rate,
+            "num_requests": cfg.num_requests,
+            "max_prompt_len": cfg.max_prompt_len,
+            "max_output_len": cfg.max_output_len,
+            "buckets": list(engine.batch_buckets),
+            "max_in_flight": engine.cap,
+            "kv_page_size": engine.page_size,
+            "kv_pages": engine.num_pages,
+            "p99_ms": ct["p99_e2e_ms"],
+            "goodput": ct["goodput"],
+            "tokens_per_s": ct["tokens_per_s"],
+            "arms": arms,
+            "verdict": verdict,
+        },
+        "manifest": manifest,
+    }
+
+
+def main() -> int:
+    env = os.environ.get
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default=env("BENCH_MODEL", "moe_tiny"))
+    ap.add_argument("--arrival", default=env("BENCH_ARRIVAL", "poisson"))
+    ap.add_argument("--arrival_rate", type=float,
+                    default=float(env("BENCH_ARRIVAL_RATE", "16")))
+    ap.add_argument("--num_requests", type=int,
+                    default=int(env("BENCH_REQUESTS", "48")))
+    ap.add_argument("--serve_buckets",
+                    default=env("BENCH_SERVE_BUCKETS", "auto"))
+    ap.add_argument("--max_in_flight", type=int,
+                    default=int(env("BENCH_MAX_IN_FLIGHT", "8")))
+    ap.add_argument("--kv_page_size", type=int, default=16)
+    ap.add_argument("--max_prompt_len", type=int, default=32)
+    ap.add_argument("--max_output_len", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--compile_cache",
+                    default=env("BENCH_COMPILE_CACHE") or None,
+                    help="persistent compile cache dir — makes the "
+                         "post_warmup_compiles=0 assertion a measured "
+                         "cache-entry delta instead of a trivial 0")
+    ap.add_argument("--metrics_root", default=None,
+                    help="write per-arm metrics dirs here; compare with "
+                         "`python -m tpu_hc_bench.obs diff "
+                         "<root>/static <root>/continuous`")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="also write the comparison JSON here")
+    args = ap.parse_args()
+
+    result = run_ab(args)
+    print(json.dumps(result, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"wrote {args.json}", file=sys.stderr)
+    v = result["extra"]["verdict"]
+    ok = (v["continuous_beats_static_p99"]
+          and v["continuous_beats_static_goodput"]
+          and v["zero_post_warmup_compiles"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

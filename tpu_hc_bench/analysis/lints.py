@@ -86,8 +86,9 @@ CKPT_TOPOLOGY = "checkpoint-topology"
 INPUT_POOL = "input-pool-width"
 TUNED_STALENESS = "tuned-config-staleness"
 HOT_MEMORY = "memory-probe-in-hot-loop"
+SERVE_RECOMPILE = "serve-bucket-recompile"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
-                    INPUT_POOL, HOT_MEMORY)
+                    INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -649,6 +650,59 @@ class _FileLinter:
                 return True
         return False
 
+    # -- serve-bucket-recompile ----------------------------------------
+
+    # calls that lower/trace a program (and so can compile a NEW shape):
+    # the serve package's zero-recompile-after-warmup contract says
+    # these may only appear in the engine's warmup namespace
+    _LOWERING_CALLEES = {
+        "jit", "pjit", "pmap", "shard_map", "aot_compile", "lower",
+        "compile", "xla_computation", "make_jaxpr", "eval_shape",
+    }
+    _WARMUP_FUNCS = ("__init__", "_aot")
+
+    def _in_serve_package(self) -> bool:
+        parts = Path(self.filename).as_posix().split("/")
+        return "serve" in parts and "tests" not in parts
+
+    def _check_serve_recompile(self):
+        """**serve-bucket-recompile** (warning, serve package only): a
+        call site that can reach jit/lowering outside the engine's
+        warmup namespace (``__init__`` / ``_aot`` / ``_warm*``).
+
+        The serving lane's latency contract is *zero lowering after
+        warmup*: every (batch, seqlen) bucket is AOT-compiled at engine
+        construction, and after that the traffic path only calls AOT
+        executables — an off-ladder shape raises instead of silently
+        recompiling.  A ``jax.jit``/``.lower()``/``aot_compile`` call
+        that creeps into the admission/decode path re-opens the
+        mid-traffic-recompile hazard this subsystem exists to close
+        (measured as ``post_warmup_compiles`` via compile-cache entry
+        deltas).  Warmup-only namespaces are exempt; so is anything
+        outside ``tpu_hc_bench/serve/``.
+        """
+        if not self._in_serve_package():
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base = _callee_basename(node)
+            if base not in self._LOWERING_CALLEES:
+                continue
+            names = [getattr(f, "name", "<lambda>")
+                     for f in self._enclosing_functions(node)]
+            if any(n in self._WARMUP_FUNCS or n.startswith("_warm")
+                   for n in names):
+                continue
+            where = names[0] if names else "module level"
+            self._emit(
+                SERVE_RECOMPILE, "warning", node,
+                f"{_dotted(node.func) or base}() in {where} can lower/"
+                f"compile after engine warmup — the serving lane's "
+                f"zero-recompile contract keeps jit/lowering inside "
+                f"the warmup namespace (__init__/_aot/_warm*); route "
+                f"this through a warmed AOT bucket instead")
+
     # -- driver --------------------------------------------------------
 
     def run(self) -> list[Finding]:
@@ -659,6 +713,7 @@ class _FileLinter:
         self._check_checkpoint_topology()
         self._check_input_pool()
         self._check_memory_probe_hot_loop()
+        self._check_serve_recompile()
         return self.findings
 
 
@@ -710,11 +765,19 @@ def check_tuned_registry(
     points at every registry row still spelling the old name.  An
     unreadable registry file flags too — a truncated write would
     otherwise silently disable tuning for that hardware.
+
+    Serving rows (round 16) are keyed ``<model>@serve`` and get the
+    same treatment, plus a lane check: a ``@serve`` row recording a
+    training-lane lever (or a training row recording a serving knob)
+    is flagged — ``resolve_auto`` skips such a key with a note, and
+    this lint is what makes the skip visible in CI instead of silently
+    de-tuning the lane forever.
     """
     import dataclasses
     import json
 
     from tpu_hc_bench.flags import BenchmarkConfig
+    from tpu_hc_bench.tune.space import LEVERS, SERVE_LEVERS
 
     if registry_dir is None:
         from tpu_hc_bench.tune.registry import default_registry_dir
@@ -735,17 +798,29 @@ def check_tuned_registry(
                 f"unreadable registry file: {e}"))
             continue
         for model, row in sorted((data.get("members") or {}).items()):
+            serving = model.endswith("@serve")
+            member = model[:-len("@serve")] if serving else model
+            lane_levers = SERVE_LEVERS if serving else LEVERS
+            crossed = SERVE_LEVERS if not serving else LEVERS
             recorded = {**(row.get("base") or {}),
                         **(row.get("overrides") or {})}
             for k in sorted(recorded):
                 if k not in fields:
                     findings.append(Finding(
-                        TUNED_STALENESS, "warning", model,
+                        TUNED_STALENESS, "warning", member,
                         f"artifacts/tuned/{path.name}:{model}/{k}",
                         f"tuned row records flag {k!r}, which is no "
                         f"longer a BenchmarkConfig field — re-run "
                         f"`python -m tpu_hc_bench.tune search` or edit "
                         f"the row"))
+                elif k in crossed and k not in lane_levers:
+                    lane = "serving" if serving else "training"
+                    findings.append(Finding(
+                        TUNED_STALENESS, "warning", member,
+                        f"artifacts/tuned/{path.name}:{model}/{k}",
+                        f"{lane} row records the other lane's lever "
+                        f"{k!r} — --config=auto skips it with a note; "
+                        f"re-search the row or drop the key"))
     return findings
 
 

@@ -265,6 +265,62 @@ class PackedTokenDataset:
             step += 1
 
 
+@dataclasses.dataclass
+class PromptSampler:
+    """Per-request prompt sampler for the serving lane
+    (``tpu_hc_bench.serve``).
+
+    Two sources behind one contract (``sample(rid, length) -> int32
+    tokens``, deterministic per ``(seed, rid)`` and independent of
+    consumer pacing — the ``TokenDataset`` counter-rng idiom):
+
+    - **corpus** (``data_dir`` set): a window is drawn from the
+      memory-mapped pre-tokenized stream and cut at the first
+      end-of-document boundary via the packing machinery's
+      ``split_documents`` — prompts end where real documents do, so
+      sampled lengths have the ragged shape serving systems actually
+      see (a returned prompt may be SHORTER than requested).
+    - **synthetic** (``data_dir`` None): uniform ids over
+      ``[1, vocab_size)`` at exactly the requested length (0 is
+      reserved as the eod/pad id).
+    """
+
+    vocab_size: int
+    data_dir: str | Path | None = None
+    split: str = "train"
+    eod_id: int = 0
+    seed: int = 0
+
+    def __post_init__(self):
+        self._data = None
+        if self.data_dir is not None:
+            path, dtype = _resolve(self.data_dir, self.split)
+            self._data = np.memmap(path, dtype=dtype, mode="r")
+            if len(self._data) < 2:
+                raise ValueError(f"{path}: corpus too small to sample "
+                                 f"prompts from")
+
+    def sample(self, rid: int, length: int) -> np.ndarray:
+        """The prompt for request ``rid`` at (up to) ``length`` tokens."""
+        if length < 1:
+            raise ValueError(f"prompt length must be >= 1: {length}")
+        rng = np.random.default_rng((self.seed, 11, rid))
+        if self._data is None:
+            return rng.integers(1, max(2, self.vocab_size),
+                                size=(length,), dtype=np.int64
+                                ).astype(np.int32)
+        span = min(length, len(self._data))
+        start = int(rng.integers(0, len(self._data) - span + 1))
+        window = np.asarray(self._data[start:start + span])
+        docs = split_documents(window, self.eod_id)
+        prompt = docs[0] if docs else window
+        # an eod-led window can yield a 1-token document; prompts are
+        # >= 1 token by construction either way
+        out = np.asarray(prompt, dtype=np.int64)
+        out = np.clip(out, 0, self.vocab_size - 1)
+        return out.astype(np.int32)
+
+
 def main(argv=None) -> int:
     """Operator CLI: write a corpus in the wire format.
 

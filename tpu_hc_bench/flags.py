@@ -55,6 +55,80 @@ DEFAULT_FUSION_THRESHOLD_BYTES = 134217728
 # block in resolve(), which translates variable_update replicated->psum
 SEQ_SHARDED_IMPLS = ("ring", "ulysses", "ulysses_flash")
 
+# --- serving lane (round 16) ------------------------------------------
+# Training-only knobs that have no meaning under `python -m tpu_hc_bench
+# serve`: a serving run that silently accepted --gradient_accumulation_
+# steps or --on_nonfinite=rewind would wear a banner describing machinery
+# that never ran, so resolve() rejects any of these the operator
+# explicitly set (flag-time, the same loudness contract as every other
+# invalid combination).  Knobs shared by both lanes (model, seed, dtype,
+# data_dir for the prompt corpus, compile_cache, metrics_dir, device,
+# hbm_budget, config) are deliberately absent.
+TRAIN_ONLY_FLAGS = (
+    "batch_size", "num_warmup_batches", "num_batches", "num_epochs",
+    "display_every", "optimizer", "forward_only", "eval",
+    "init_learning_rate", "momentum", "data_format",
+    "use_fp16",  # serving runs f32 reference decode for now (ROADMAP:
+                 # quantized serving arms)
+    "variable_update", "overlap_grad_comm", "fusion_threshold_bytes",
+    "num_intra_threads", "num_inter_threads", "kmp_blocktime",
+    "kmp_affinity", "datasets_num_private_threads",
+    "datasets_repeat_cached_sample", "train_dir", "save_model_steps",
+    "async_checkpoint", "prefetch_depth", "input_service",
+    "service_decode_workers", "full_batch_identity", "on_nonfinite",
+    "max_bad_steps", "resume", "step_timeout_s", "keep_checkpoints",
+    "inject_fault", "profile_steps", "fabric_ceiling", "num_slices",
+    "fused_conv", "fused_xent", "use_space_to_depth", "seq_len",
+    "wire_dtype", "gradient_accumulation_steps", "accum_dtype",
+    "model_parallel", "expert_parallel", "pipeline_parallel",
+    "num_microbatches", "sequence_parallel", "gradient_checkpointing",
+    "attention_impl", "moe_impl", "moe_capacity_factor", "moe_f_chunk",
+    "scan_layers", "rnn_impl",
+)
+
+# The serving lane's own knobs — rejected with the mirror-image error
+# when explicitly set on a TRAINING run, so neither lane ever silently
+# ignores the other's flags.
+SERVE_ONLY_FLAGS = (
+    "arrival", "arrival_rate", "num_requests", "serve_buckets",
+    "max_in_flight", "kv_page_size", "kv_pages", "max_prompt_len",
+    "max_output_len", "batching",
+)
+
+
+def parse_serve_buckets(spec: str, max_in_flight: int) -> tuple[int, ...]:
+    """Resolve ``--serve_buckets`` into the decode batch-bucket ladder.
+
+    ``auto`` = the power-of-two ladder 1, 2, 4, ... up to
+    ``max_in_flight`` (``max_in_flight`` itself appended when it is not
+    a power of two), so every admissible in-flight count has a bucket
+    within 2x.  An explicit spec is comma-separated positive ints
+    (``"1,4,8"``); loud on malformed input.  The engine AOT-compiles
+    one decode executable per bucket at warmup — the ladder IS the set
+    of shapes that can ever run, so a request count above the top
+    bucket is an admission-control clamp, never a new compile.
+    """
+    if max_in_flight < 1:
+        raise ValueError(f"--max_in_flight must be >= 1: {max_in_flight}")
+    if spec == "auto":
+        ladder = []
+        b = 1
+        while b < max_in_flight:
+            ladder.append(b)
+            b *= 2
+        ladder.append(max_in_flight)
+        return tuple(ladder)
+    try:
+        vals = sorted({int(v) for v in spec.split(",") if v.strip()})
+    except ValueError:
+        raise ValueError(
+            f"--serve_buckets must be 'auto' or comma-separated ints "
+            f"(decode batch buckets): {spec!r}") from None
+    if not vals or vals[0] < 1:
+        raise ValueError(
+            f"--serve_buckets needs at least one positive bucket: {spec!r}")
+    return tuple(vals)
+
 
 def parse_profile_steps(spec: str) -> tuple[int, int]:
     """Parse ``--profile_steps=a:b`` into an inclusive timed-step window.
@@ -417,6 +491,64 @@ class BenchmarkConfig:
                                               # io_error@ckpt
                                               # (resilience/inject.py)
 
+    # --- serving lane (round 16; tpu_hc_bench.serve) ---
+    workload: str = "train"                   # train|serve: which lane this
+                                              # config drives.  Set by the
+                                              # serve CLI (`python -m
+                                              # tpu_hc_bench serve`), never a
+                                              # user flag — the entry point
+                                              # IS the workload selection.
+                                              # resolve() rejects the other
+                                              # lane's knobs loudly under
+                                              # either value.
+    arrival: str = "poisson"                  # synthetic request arrival
+                                              # process: poisson (memoryless
+                                              # open loop) | bursty (on/off
+                                              # duty cycle) | diurnal
+                                              # (sinusoidal rate — the
+                                              # day/night traffic shape,
+                                              # compressed)
+    arrival_rate: float = 8.0                 # mean request arrival rate,
+                                              # requests/second (the load
+                                              # axis of the SLO report)
+    num_requests: int = 64                    # requests in the closed-loop
+                                              # run (the serving analog of
+                                              # --num_batches)
+    serve_buckets: str = "auto"               # decode batch-bucket ladder:
+                                              # auto = powers of two up to
+                                              # max_in_flight, or explicit
+                                              # "1,2,8".  Every bucket is
+                                              # AOT-compiled at warmup; the
+                                              # ladder is the complete set
+                                              # of shapes that can ever run
+    max_in_flight: int = 8                    # continuous-batching admission
+                                              # cap: requests decoding
+                                              # concurrently (also the
+                                              # static arm's batch size)
+    kv_page_size: int = 16                    # tokens per KV-cache page
+                                              # (vLLM-style paged KV: decode
+                                              # members allocate cache in
+                                              # fixed pages, never per-
+                                              # sequence max-length slabs)
+    kv_pages: int = 0                         # total pages in the pool
+                                              # (0 = auto: enough for
+                                              # max_in_flight sequences at
+                                              # max_prompt_len +
+                                              # max_output_len, + the
+                                              # reserved trash page)
+    max_prompt_len: int = 64                  # prompt-length ceiling; the
+                                              # prefill bucket ladder pads
+                                              # up to it
+    max_output_len: int = 32                  # generation ceiling per
+                                              # request (requests retire at
+                                              # max_output_len tokens)
+    batching: str = "continuous"              # continuous: admit/retire
+                                              # per decode step (Orca-style)
+                                              # | static: collect a full
+                                              # batch, run it to completion,
+                                              # only then admit again (the
+                                              # A/B control arm)
+
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
     # config provenance (resolve()): manual = hand-set flags, auto = a
@@ -437,6 +569,75 @@ class BenchmarkConfig:
     def compute_dtype(self) -> str:
         """bfloat16 when fp16 requested (TPU has no fp16 MXU path), else f32."""
         return "bfloat16" if self.use_fp16 else "float32"
+
+    def _explicitly_set(self, names: Sequence[str]) -> list[str]:
+        """The subset of ``names`` the operator actually set: named in
+        ``explicit_flags`` when the config came through ``parse_flags``
+        (so an explicit flag typed at its default value still counts),
+        else any field whose value differs from the dataclass default
+        (the programmatic-construction fallback — the same two-tier
+        rule ``tune.registry.resolve_auto`` uses for pinning)."""
+        if self.explicit_flags is not None:
+            return [n for n in names if n in self.explicit_flags]
+        defaults = {f.name: f.default for f in dataclasses.fields(self)}
+        return [n for n in names
+                if n in defaults and getattr(self, n) != defaults[n]]
+
+    def _resolve_serving(self, t: dict[str, str]) -> "BenchmarkConfig":
+        """The ``workload="serve"`` half of resolve(): the serving lane
+        shares the parser (every flag still parses) but owns its own
+        validity matrix — a training-only knob silently ignored here
+        would wear a banner describing machinery that never ran, so it
+        dies at flag time instead."""
+        bad = self._explicitly_set(TRAIN_ONLY_FLAGS)
+        if bad:
+            raise ValueError(
+                "training-only flag(s) have no meaning under `python -m "
+                "tpu_hc_bench serve`: "
+                + ", ".join(f"--{b}" for b in bad)
+                + " (the serving lane sizes work by --serve_buckets/"
+                  "--max_in_flight/--max_prompt_len and owns its own "
+                  "decode step; drop the flag or run the training lane)")
+        # compute-engine translations shared with the training lane
+        if self.mkl:
+            t["mkl"] = "TRUE->no-op (XLA:TPU is the compute engine)"
+            self.mkl = False
+        if self.device == "cpu":
+            t["device"] = "cpu->tpu (per-launcher target platform)"
+            self.device = "tpu"
+        if self.arrival not in ("poisson", "bursty", "diurnal"):
+            raise ValueError(
+                f"--arrival must be poisson|bursty|diurnal: {self.arrival!r}")
+        if self.arrival_rate <= 0:
+            raise ValueError(
+                f"--arrival_rate must be > 0 req/s: {self.arrival_rate}")
+        if self.num_requests < 1:
+            raise ValueError(
+                f"--num_requests must be >= 1: {self.num_requests}")
+        if self.kv_page_size < 1:
+            raise ValueError(
+                f"--kv_page_size must be >= 1 token: {self.kv_page_size}")
+        if self.kv_pages < 0:
+            raise ValueError(
+                f"--kv_pages must be >= 0 (0 = auto): {self.kv_pages}")
+        if self.max_prompt_len < 1:
+            raise ValueError(
+                f"--max_prompt_len must be >= 1: {self.max_prompt_len}")
+        if self.max_output_len < 1:
+            raise ValueError(
+                f"--max_output_len must be >= 1: {self.max_output_len}")
+        if self.batching not in ("continuous", "static"):
+            raise ValueError(
+                f"--batching must be continuous|static: {self.batching!r}")
+        # loud format checks (raise on malformed spec; values re-read by
+        # the engine)
+        parse_serve_buckets(self.serve_buckets, self.max_in_flight)
+        if self.hbm_budget is not None:
+            from tpu_hc_bench.obs.memory import parse_hbm_budget
+
+            parse_hbm_budget(self.hbm_budget)
+        self.translations = t
+        return self
 
     def resolve(self) -> "BenchmarkConfig":
         """Apply TPU translations of reference-literal flag values.
@@ -459,6 +660,21 @@ class BenchmarkConfig:
             from tpu_hc_bench.tune import registry as tune_registry
 
             t["config"] = tune_registry.resolve_auto(self)
+        if self.workload not in ("train", "serve"):
+            raise ValueError(
+                f"workload must be train|serve: {self.workload!r}")
+        if self.workload == "serve":
+            # the serving lane (round 16): its own validity matrix, and
+            # none of the training-lane translations/duration defaults
+            # below apply
+            return self._resolve_serving(t)
+        extras = self._explicitly_set(SERVE_ONLY_FLAGS)
+        if extras:
+            raise ValueError(
+                "serving-lane flag(s) have no meaning in the training "
+                "lane: " + ", ".join(f"--{e}" for e in extras)
+                + " — run `python -m tpu_hc_bench serve` for the "
+                  "request-driven benchmark")
         if self.data_format.upper() == "NCHW":
             t["data_format"] = "NCHW->NHWC (MXU wants channels-minor)"
             self.data_format = "NHWC"
@@ -825,6 +1041,23 @@ class BenchmarkConfig:
 
     def summary_lines(self) -> list[str]:
         """Config header in the spirit of run-tf-sing-ucx-openmpi.sh:52-58."""
+        if self.workload == "serve":
+            buckets = ",".join(
+                str(b) for b in parse_serve_buckets(self.serve_buckets,
+                                                    self.max_in_flight))
+            lines = [
+                f"model={self.model} workload=serve "
+                f"batching={self.batching} dtype={self.compute_dtype}",
+                f"arrival={self.arrival} rate={self.arrival_rate}/s "
+                f"requests={self.num_requests} "
+                f"prompt<={self.max_prompt_len} output<={self.max_output_len}",
+                f"buckets={buckets} max_in_flight={self.max_in_flight} "
+                f"kv_page_size={self.kv_page_size} "
+                f"kv_pages={self.kv_pages or 'auto'}",
+            ]
+            for k, v in self.translations.items():
+                lines.append(f"translated: {k}: {v}")
+            return lines
         lines = [
             f"model={self.model} batch_size/worker={self.batch_size} "
             f"optimizer={self.optimizer} dtype={self.compute_dtype}",
@@ -973,11 +1206,34 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["hoisted", "bidi", "flax"])
     p.add_argument("--scan_layers", type=_parse_bool, default=d.scan_layers)
     p.add_argument("--moe_f_chunk", type=int, default=d.moe_f_chunk)
+    # --- serving lane (round 16): parse everywhere, validated by
+    # resolve() under workload="serve" only (and rejected loudly when
+    # explicitly set on a training run) ---
+    p.add_argument("--arrival", type=str, default=d.arrival,
+                   choices=["poisson", "bursty", "diurnal"])
+    p.add_argument("--arrival_rate", type=float, default=d.arrival_rate)
+    p.add_argument("--num_requests", type=int, default=d.num_requests)
+    p.add_argument("--serve_buckets", type=str, default=d.serve_buckets,
+                   metavar="auto|B1,B2,...")
+    p.add_argument("--max_in_flight", type=int, default=d.max_in_flight)
+    p.add_argument("--kv_page_size", type=int, default=d.kv_page_size)
+    p.add_argument("--kv_pages", type=int, default=d.kv_pages)
+    p.add_argument("--max_prompt_len", type=int, default=d.max_prompt_len)
+    p.add_argument("--max_output_len", type=int, default=d.max_output_len)
+    p.add_argument("--batching", type=str, default=d.batching,
+                   choices=["continuous", "static"])
     return p
 
 
-def parse_flags(argv: Sequence[str] | None = None) -> BenchmarkConfig:
-    """Parse a tf_cnn_benchmarks-style argv into a resolved BenchmarkConfig."""
+def parse_flags(argv: Sequence[str] | None = None,
+                workload: str = "train") -> BenchmarkConfig:
+    """Parse a tf_cnn_benchmarks-style argv into a resolved BenchmarkConfig.
+
+    ``workload`` is set by the entry point, not a flag: the serve CLI
+    (`python -m tpu_hc_bench serve`) passes ``"serve"`` so resolve()
+    runs the serving lane's validity matrix (and the tuned-config
+    registry keys its lookup on the ``<model>@serve`` row).
+    """
     if argv is None:
         import sys
 
@@ -989,6 +1245,7 @@ def parse_flags(argv: Sequence[str] | None = None) -> BenchmarkConfig:
     }
     kwargs["data_format"] = kwargs["data_format"].upper()
     cfg = BenchmarkConfig(**kwargs)
+    cfg.workload = workload
     # record what the operator actually typed BEFORE resolve():
     # --config=auto must honor an explicit flag even when its value
     # equals the dataclass default

@@ -49,13 +49,25 @@ def parse_positionals(argv: list[str]):
     if len(pos) not in (0, 4):
         raise SystemExit(
             "usage: python -m tpu_hc_bench [NUM_HOSTS WORKERS_PER_HOST "
-            "BATCH_SIZE FABRIC(ib|sock|ici|dcn|host)] [--tf_cnn_flags...]"
+            "BATCH_SIZE FABRIC(ib|sock|ici|dcn|host)] [--tf_cnn_flags...]\n"
+            "       python -m tpu_hc_bench serve [--serve_flags...]  "
+            "(request-driven serving benchmark)"
         )
     return pos, rest
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "serve":
+        # the serving lane (round 16): `python -m tpu_hc_bench serve
+        # [--tf_flags...]` — request-driven benchmark with continuous
+        # batching over AOT bucket shapes (tpu_hc_bench.serve).  The
+        # subcommand replaces the positional NUM_HOSTS/WORKERS/BATCH/
+        # FABRIC contract: serving sizes its own work (--serve_buckets/
+        # --max_in_flight) and runs single-process for now.
+        from tpu_hc_bench.serve import cli as serve_cli
+
+        return serve_cli.main(argv[1:])
     pos, rest = parse_positionals(argv)
     if pos:
         num_hosts, workers_per_host = int(pos[0]), int(pos[1])

@@ -48,15 +48,22 @@ def apply_rope(x, positions, theta: float = 10000.0):
     """Rotary position embedding over the trailing head_dim.
 
     ``x``: [batch, seq, heads, head_dim]; ``positions``: [seq] global
-    token positions (sequence-parallel shards pass their offset range).
-    Split-half convention (rotate_half), f32 trig, output in x's dtype.
+    token positions shared across the batch (sequence-parallel shards
+    pass their offset range), or [batch, seq] per-row positions (the
+    serving lane's decode step, where every in-flight request sits at
+    its own cache depth).  Split-half convention (rotate_half), f32
+    trig, output in x's dtype.
     """
     d = x.shape[-1]
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]          # [1, S, 1, half]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    if angles.ndim == 2:                             # [S, half]
+        cos = jnp.cos(angles)[None, :, None, :]      # [1, S, 1, half]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:                                            # [B, S, half]
+        cos = jnp.cos(angles)[:, :, None, :]         # [B, S, 1, half]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     out = jnp.concatenate(

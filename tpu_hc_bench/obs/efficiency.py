@@ -106,6 +106,26 @@ def _probe_handles(step_fn, example_args):
         return None
 
 
+def aot_compile(jitted, *example_args):
+    """AOT lower+compile a jitted callable at abstracted argument shapes.
+
+    The ONE lowering path shared by the FLOPs/memory probe and the
+    serving engine's bucket warmup (``tpu_hc_bench.serve.engine``): the
+    example args are abstracted to ShapeDtypeStructs (committed
+    shardings carried, donated/consumed buffers never touched, nothing
+    executes), then ``jitted.lower(...).compile()`` produces the
+    executable.  Because the result is an AOT ``Compiled`` handle, a
+    call at any OTHER shape raises instead of silently recompiling —
+    the property the serving lane's zero-recompile-after-warmup
+    contract is built on.  Raises on lowering failure (callers that
+    want the probe's None-degradation use ``_lowered_compiled``).
+    """
+    import jax
+
+    abstract = jax.tree.map(_abstractify, example_args)
+    return jitted.lower(*abstract).compile()
+
+
 def _lowered_compiled(jitted, abstract):
     try:
         return jitted.lower(*abstract).compile()

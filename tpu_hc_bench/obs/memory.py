@@ -512,9 +512,14 @@ def resolve_hbm_budget_bytes(parsed) -> tuple[int | None, str | None]:
 
 
 def budget_lines(measured: dict | None, budget_bytes: int | None,
-                 note: str | None = None) -> list[str]:
+                 note: str | None = None,
+                 advice: str | None = None) -> list[str]:
     """The pre-run budget verdict: loud WARNING when the AOT memory
-    report exceeds the budget, one quiet confirmation line otherwise."""
+    report exceeds the budget, one quiet confirmation line otherwise.
+    ``advice`` is the lane's shrink-this suggestion (defaults to the
+    training knobs)."""
+    advice = advice or ("shrink --batch_size or raise "
+                        "--gradient_accumulation_steps")
     if note:
         return [f"WARNING: {note}"]
     if budget_bytes is None:
@@ -531,9 +536,7 @@ def budget_lines(measured: dict | None, budget_bytes: int | None,
             f"WARNING: --hbm_budget: AOT memory report "
             f"{total / 2**30:.2f} GiB ({detail}) EXCEEDS the budget "
             f"{budget_bytes / 2**30:.2f} GiB — this run is likely to "
-            f"OOM; shrink --batch_size or raise "
-            f"--gradient_accumulation_steps before paying for the full "
-            f"run"]
+            f"OOM; {advice} before paying for the full run"]
     return [f"hbm budget: AOT memory report {total / 2**30:.2f} GiB "
             f"({detail}) fits the budget {budget_bytes / 2**30:.2f} GiB "
             f"({total / budget_bytes:.0%})"]

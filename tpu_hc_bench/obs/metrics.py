@@ -63,6 +63,19 @@ def _git_sha() -> str:
     return "unknown"
 
 
+#: the run-identity fields every BENCH-style JSON record embeds — ONE
+#: spelling so bench.py's two entries and scripts/bench_serve.py can
+#: never drift apart
+MANIFEST_IDENTITY_KEYS = (
+    "git_sha", "jax_version", "jaxlib_version", "platform",
+    "device_kind", "process_count", "device_count", "created_unix")
+
+
+def manifest_subset(manifest: dict) -> dict:
+    """The BENCH-record identity slice of a full run manifest."""
+    return {k: manifest.get(k) for k in MANIFEST_IDENTITY_KEYS}
+
+
 def run_manifest(cfg: Any = None, layout: Any = None, mesh: Any = None,
                  fabric: str | None = None,
                  extra: dict | None = None) -> dict:
@@ -376,6 +389,18 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
             lines.append(
                 f"  {w.get('step', '?'):>6} {w.get('rate', 0.0):10.1f} "
                 f"{w.get('step_ms', 0.0):9.2f} {w.get('loss', 0.0):8.3f}")
+    # serving lane (round 16): request/serve records fold into the SLO
+    # section; a stream with ONLY serving records (no step-keyed
+    # metrics at all) is a normal serving run, not a degraded training
+    # one — label it instead of rendering an empty table
+    from tpu_hc_bench.serve import slo as slo_mod
+
+    serve_fold = slo_mod.fold_serve_records(records)
+    if serve_fold is not None:
+        if not windows and not _last(records, "summary"):
+            lines.append("  serving run (request-keyed metrics; no "
+                         "step-keyed training records)")
+        lines.extend(slo_mod.slo_lines(serve_fold))
     summary = _last(records, "summary")
     if summary:
         lines.append(
@@ -533,13 +558,23 @@ def diff_runs(path_a: str, path_b: str,
         ("goodput", "goodput"),
         ("final loss", "final_loss"),
     )
-    lines.append(f"  {'metric':>14s} {'a':>12s} {'b':>12s} {'delta':>8s}")
-    for label, key in metrics:
-        if key not in sum_a and key not in sum_b:
-            continue
-        va, vb = sum_a.get(key, 0.0), sum_b.get(key, 0.0)
-        lines.append(f"  {label:>14s} {va:12.4g} {vb:12.4g} "
-                     f"{_pct(va, vb):>8s}")
+    rows = [(label, key) for label, key in metrics
+            if key in sum_a or key in sum_b]
+    if rows:
+        lines.append(f"  {'metric':>14s} {'a':>12s} {'b':>12s} "
+                     f"{'delta':>8s}")
+        for label, key in rows:
+            va, vb = sum_a.get(key, 0.0), sum_b.get(key, 0.0)
+            lines.append(f"  {label:>14s} {va:12.4g} {vb:12.4g} "
+                         f"{_pct(va, vb):>8s}")
+    # serving lane: p99/goodput/tokens-per-s deltas when both runs
+    # carry request-keyed records (step-free serving runs diff cleanly
+    # instead of rendering an empty training table)
+    from tpu_hc_bench.serve import slo as slo_mod
+
+    lines.extend(slo_mod.serve_diff_lines(
+        slo_mod.fold_serve_records(recs_a),
+        slo_mod.fold_serve_records(recs_b)))
     src_a = sum_a.get("mfu_source")
     src_b = sum_b.get("mfu_source")
     if (src_a or src_b) and src_a != src_b:

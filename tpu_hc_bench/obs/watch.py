@@ -62,7 +62,8 @@ def render(path: str, manifest: dict, records: list[dict],
                 + f" (heartbeat)   step ~"
                 f"{last.get('step_ewma_ms', 0.0):.1f}ms ewma"
                 + (f"   mem peak {mem / 2**20:.1f} MiB" if mem else ""))
-    else:
+    elif not any(r.get("kind") in ("serve", "serve_summary", "request")
+                 for r in records):
         lines.append("  (no progress records yet)")
     # fleet memory: the heartbeat mem_peak_bytes field, max across the
     # hosts' freshest beats (previously received and dropped)
@@ -87,6 +88,12 @@ def render(path: str, manifest: dict, records: list[dict],
             f"  DONE: total {summary.get('total_images_per_sec', 0.0):.2f} "
             f"ex/s  mean step {summary.get('mean_step_ms', 0.0):.2f}ms")
         lines.extend(eff_mod.mfu_lines(summary))
+    # serving lane (round 16): live queue/in-flight panel + completed-
+    # request percentiles (serve/request records; training runs skip
+    # this in one list scan)
+    from tpu_hc_bench.serve import slo as slo_mod
+
+    lines.extend(slo_mod.watch_lines(records))
     res = [r for r in records
            if r.get("kind") in metrics_mod.RESILIENCE_KINDS]
     if res:
@@ -115,7 +122,10 @@ def watch(path: str, out=None, interval: float = 1.0,
         problems: list[str] = []
         manifest, records = metrics_mod.read_run(path, problems=problems)
         panel = render(path, manifest, records, problems=problems)
-        done = any(r.get("kind") == "summary" for r in records)
+        # a serving run's terminal record is serve_summary (the lane
+        # never emits step-keyed summaries) — either one ends the watch
+        done = any(r.get("kind") in ("summary", "serve_summary")
+                   for r in records)
         if tty:
             if prev_height:
                 out.write(f"\x1b[{prev_height}A")

@@ -1,0 +1,3 @@
+from tpu_hc_bench.serve.cli import main
+
+raise SystemExit(main())

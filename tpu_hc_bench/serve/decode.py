@@ -1,0 +1,319 @@
+"""Paged-KV prefill/decode programs for the decoder zoo members.
+
+The training models are Flax modules whose ``__call__`` is a full
+prefill-shaped forward; serving needs *incremental* decode — one token
+per request per step, attending over everything generated so far.
+Rather than fork the model definitions, this module re-walks each
+family's OWN param tree functionally (the ``pp_embed``/``pp_head``
+discipline ``parallel.pipeline`` established): every matmul/norm is the
+family's own Flax sub-module ``.apply``'d onto its param subtree, and
+only the attention inner product — the part that must read a KV cache
+— is reimplemented, with the same f32-softmax/1-over-sqrt(d)
+convention as ``parallel.sequence.dense_attention``.  Numerical parity
+with ``model.apply`` over the full context is pinned by
+``tests/test_serve.py``.
+
+**Paged KV cache** (vLLM): one pool of fixed-size pages per run,
+``k_pages``/``v_pages`` shaped ``[layers, pages, page_size, kv_heads,
+head_dim]``.  A request holds a page *table* (int32 page indices); the
+decode step gathers its keys by table lookup and scatters the new
+token's K/V into ``table[pos // page]``.  Page 0 is the reserved
+*trash* page: padded/inactive rows write there (and are masked on
+read), so one compiled program serves any admission pattern.
+
+Two compiled shapes per family, both AOT-lowered at engine warmup
+(``obs.efficiency.aot_compile``):
+
+- ``prefill``: batch 1 over a padded prompt-length bucket — computes
+  the whole prompt's K/V in one pass, writes the pages, and returns
+  the first generated token (the TTFT token).
+- ``decode``: one token for a batch-bucket of in-flight requests at
+  *per-row* cache depths (the continuous-batching shape).
+
+Supported families: ``GPTLM`` (gpt2*, moe*: learned positions, dense
+or MoE FFN) and ``LlamaLM`` (llama*: RoPE, GQA, SwiGLU).  Everything
+else that claims ``causal_lm`` fails loudly at engine construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _softmax_attend(q, keys, values, mask):
+    """Single-query attention over gathered cache rows.
+
+    ``q`` [b, 1, heads, d]; ``keys``/``values`` [b, S, heads, d];
+    ``mask`` [b, S] bool (True = attend).  Same convention as
+    ``parallel.sequence.dense_attention``: f32 scores, 1/sqrt(d) scale,
+    probabilities cast back to the value dtype.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, keys,
+                   preferred_element_type=jnp.float32) * (1.0 / d ** 0.5)
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(values.dtype), values)
+
+
+@dataclasses.dataclass
+class _Family:
+    """One decoder family's functional pieces over its own param tree."""
+
+    model: Any
+    num_layers: int
+    heads: int
+    kv_heads: int
+    head_dim: int
+    embed_decode: Callable      # (params, tokens [b], positions [b]) -> [b,1,H]
+    layer_params: Callable      # (params, l) -> layer subtree
+    attn_norm: Callable         # (p_l, x) -> normed
+    qkv: Callable               # (p_l, x, positions [b,s]) -> q, k, v
+                                # ([b,s,heads,d], [b,s,kvh,d] x2; RoPE
+                                # families rotate inside)
+    attn_out: Callable          # (p_l, ctx [b,s,heads,d]) -> [b,s,H]
+    ffn: Callable               # (p_l, x normed) -> [b,s,H]
+    ffn_norm: Callable          # (p_l, x) -> normed
+
+    def embed_prefill(self, params, tokens):
+        # positions arange(s) — exactly the training forward's layout
+        x, _ = self.model.pp_embed(params, tokens, None)
+        return x
+
+    def head(self, params, x):
+        return self.model.pp_head(params, x)
+
+
+def build_family(model) -> _Family:
+    """The family adapter for a constructed decoder module."""
+    from tpu_hc_bench.models.gpt import GPTLM
+    from tpu_hc_bench.models.llama import LlamaLM, RMSNorm, apply_rope
+
+    if isinstance(model, GPTLM):
+        if model.scan_layers:
+            raise ValueError(
+                "serving decodes the unrolled layer_i param layout; "
+                "--scan_layers checkpoints are not servable")
+        d = model.hidden // model.heads
+        dt = model.dtype
+
+        def embed_decode(params, tokens, positions):
+            wte = params["wte"]["embedding"].astype(dt)
+            wpe = params["wpe"]["embedding"].astype(dt)
+            return (wte[tokens] + wpe[positions])[:, None]
+
+        def qkv(p_l, x, positions):
+            del positions               # learned positions live in embed
+            qkv_all = nn.DenseGeneral((3, model.heads, d), dtype=dt).apply(
+                {"params": p_l["MultiHeadAttention_0"]["qkv"]}, x)
+            return qkv_all[:, :, 0], qkv_all[:, :, 1], qkv_all[:, :, 2]
+
+        def ffn(p_l, h):
+            if model.num_experts:
+                from tpu_hc_bench.models.moe import MoEFFN
+
+                # serving ALWAYS dispatches ragged (grouped matmuls):
+                # the einsum path drops capacity-overflow tokens, which
+                # is tolerable batch-shaping noise in training but a
+                # correctness hazard when serving (a request's token
+                # silently losing its FFN), and it would also make
+                # incremental decode diverge from the full forward.
+                # Zero drops == ideal top-k == prefill/decode agree
+                # exactly; param tree is impl-independent.
+                return MoEFFN(
+                    model.hidden, model.ffn, model.num_experts,
+                    top_k=model.top_k, dtype=dt, impl="ragged",
+                    ragged_f_chunk=model.moe_f_chunk,
+                ).apply({"params": p_l["moe"]}, h)
+            h = nn.Dense(model.ffn, dtype=dt).apply(
+                {"params": p_l["fc"]}, h)
+            h = nn.gelu(h)
+            return nn.Dense(model.hidden, dtype=dt).apply(
+                {"params": p_l["proj"]}, h)
+
+        return _Family(
+            model=model, num_layers=model.num_layers, heads=model.heads,
+            kv_heads=model.heads, head_dim=d,
+            embed_decode=embed_decode,
+            layer_params=lambda params, l: params[f"layer_{l}"],
+            attn_norm=lambda p_l, x: nn.LayerNorm(dtype=dt).apply(
+                {"params": p_l["ln1"]}, x),
+            qkv=qkv,
+            attn_out=lambda p_l, ctx: nn.DenseGeneral(
+                model.hidden, axis=(-2, -1), dtype=dt).apply(
+                {"params": p_l["MultiHeadAttention_0"]["out"]}, ctx),
+            ffn=ffn,
+            ffn_norm=lambda p_l, x: nn.LayerNorm(dtype=dt).apply(
+                {"params": p_l["ln2"]}, x),
+        )
+
+    if isinstance(model, LlamaLM):
+        if model.scan_layers:
+            raise ValueError(
+                "serving decodes the unrolled layer_i param layout; "
+                "--scan_layers checkpoints are not servable")
+        d = model.hidden // model.heads
+        dt = model.dtype
+
+        def embed_decode(params, tokens, positions):
+            del positions               # RoPE rotates inside attention
+            emb = params["tok_embed"]["embedding"].astype(dt)
+            return emb[tokens][:, None]
+
+        def qkv(p_l, x, positions):
+            a = p_l["attn"]
+            q = nn.DenseGeneral((model.heads, d), use_bias=False,
+                                dtype=dt).apply({"params": a["wq"]}, x)
+            k = nn.DenseGeneral((model.num_kv_heads, d), use_bias=False,
+                                dtype=dt).apply({"params": a["wk"]}, x)
+            v = nn.DenseGeneral((model.num_kv_heads, d), use_bias=False,
+                                dtype=dt).apply({"params": a["wv"]}, x)
+            return (apply_rope(q, positions), apply_rope(k, positions), v)
+
+        def ffn(p_l, h):
+            gate = nn.Dense(model.ffn, use_bias=False, dtype=dt).apply(
+                {"params": p_l["gate"]}, h)
+            up = nn.Dense(model.ffn, use_bias=False, dtype=dt).apply(
+                {"params": p_l["up"]}, h)
+            return nn.Dense(model.hidden, use_bias=False, dtype=dt).apply(
+                {"params": p_l["down"]}, nn.silu(gate) * up)
+
+        return _Family(
+            model=model, num_layers=model.num_layers, heads=model.heads,
+            kv_heads=model.num_kv_heads, head_dim=d,
+            embed_decode=embed_decode,
+            layer_params=lambda params, l: params[f"layer_{l}"],
+            attn_norm=lambda p_l, x: RMSNorm(dtype=dt).apply(
+                {"params": p_l["attn_norm"]}, x),
+            qkv=qkv,
+            attn_out=lambda p_l, ctx: nn.DenseGeneral(
+                model.hidden, axis=(-2, -1), use_bias=False,
+                dtype=dt).apply({"params": p_l["attn"]["wo"]}, ctx),
+            ffn=ffn,
+            ffn_norm=lambda p_l, x: RMSNorm(dtype=dt).apply(
+                {"params": p_l["mlp_norm"]}, x),
+        )
+
+    raise ValueError(
+        f"no paged-decode family for {type(model).__name__} (supported: "
+        "GPTLM, LlamaLM); non-causal members serve single-forward "
+        "requests instead")
+
+
+def init_kv_pages(family: _Family, num_pages: int, page_size: int,
+                  dtype) -> tuple[jax.Array, jax.Array]:
+    """The zeroed page pool: ``[L, pages, page_size, kv_heads, d]`` x2."""
+    shape = (family.num_layers, num_pages, page_size, family.kv_heads,
+             family.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def build_prefill_fn(family: _Family, page_size: int, table_width: int):
+    """The (batch-1, padded prompt bucket) prefill program.
+
+    Args at call time: ``(params, k_pages, v_pages, tokens [1, s],
+    length [], table [w])``.  Returns ``(next_token [1], logits
+    [1, vocab], k_pages, v_pages)`` with the prompt's K/V scattered
+    into the table's pages (pad positions routed to the trash page 0).
+    """
+    from tpu_hc_bench.parallel.sequence import dense_attention
+
+    def prefill(params, k_pages, v_pages, tokens, length, table):
+        s = tokens.shape[1]
+        positions = jnp.arange(s)[None, :]
+        x = family.embed_prefill(params, tokens)
+        group = family.heads // family.kv_heads
+        new_k, new_v = [], []
+        for l in range(family.num_layers):
+            p_l = family.layer_params(params, l)
+            h = family.attn_norm(p_l, x)
+            q, k, v = family.qkv(p_l, h, positions)
+            new_k.append(k)
+            new_v.append(v)
+            if group > 1:
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
+            # causal masking alone is sufficient under right-padding:
+            # the only logits read are at `length - 1`, whose keys
+            # j <= length - 1 are all valid prompt positions
+            ctx = dense_attention(q, k, v, causal=True)
+            x = x + family.attn_out(p_l, ctx)
+            x = x + family.ffn(p_l, family.ffn_norm(p_l, x))
+        x_last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
+        logits = family.head(params, x_last)[:, 0]      # [1, vocab]
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # scatter the prompt K/V into this request's pages; pads -> trash
+        pos = jnp.arange(s)
+        page_idx = jnp.where(
+            pos < length,
+            table[jnp.clip(pos // page_size, 0, table_width - 1)], 0)
+        offset = pos % page_size
+        kn = jnp.stack([k[0] for k in new_k])       # [L, s, kvh, d]
+        vn = jnp.stack([v[0] for v in new_v])
+        k_pages = k_pages.at[:, page_idx, offset].set(kn)
+        v_pages = v_pages.at[:, page_idx, offset].set(vn)
+        return next_token, logits, k_pages, v_pages
+
+    return prefill
+
+
+def build_decode_fn(family: _Family, page_size: int, table_width: int):
+    """The one-token-per-row decode program for a batch bucket.
+
+    Args at call time: ``(params, k_pages, v_pages, tokens [b],
+    tables [b, w], lengths [b], active [b])`` where ``lengths`` is each
+    row's cache depth (== the fed token's position).  Inactive rows
+    compute on the trash page and write back to it; retirement and
+    admission are pure host-side bookkeeping, never a new shape.
+    Returns ``(next_tokens [b], logits [b, vocab], k_pages, v_pages)``.
+    """
+
+    def decode(params, k_pages, v_pages, tokens, tables, lengths, active):
+        b = tokens.shape[0]
+        span = table_width * page_size
+        x = family.embed_decode(params, tokens, lengths)
+        group = family.heads // family.kv_heads
+        kv_valid = jnp.arange(span)[None, :] < lengths[:, None]
+        mask = jnp.concatenate(
+            [kv_valid, jnp.ones((b, 1), bool)], axis=1)
+        new_k, new_v = [], []
+        for l in range(family.num_layers):
+            p_l = family.layer_params(params, l)
+            h = family.attn_norm(p_l, x)
+            q, k, v = family.qkv(p_l, h, lengths[:, None])
+            new_k.append(k[:, 0])
+            new_v.append(v[:, 0])
+            kc = k_pages[l][tables].reshape(
+                b, span, family.kv_heads, family.head_dim)
+            vc = v_pages[l][tables].reshape(
+                b, span, family.kv_heads, family.head_dim)
+            keys = jnp.concatenate([kc, k], axis=1)
+            values = jnp.concatenate([vc, v], axis=1)
+            if group > 1:
+                keys = jnp.repeat(keys, group, axis=2)
+                values = jnp.repeat(values, group, axis=2)
+            ctx = _softmax_attend(q, keys, values, mask)
+            x = x + family.attn_out(p_l, ctx)
+            x = x + family.ffn(p_l, family.ffn_norm(p_l, x))
+        logits = family.head(params, x)[:, 0]
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        rows = jnp.arange(b)
+        page_idx = jnp.where(
+            active,
+            tables[rows, jnp.clip(lengths // page_size, 0,
+                                  table_width - 1)], 0)
+        offset = lengths % page_size
+        kn = jnp.stack(new_k, axis=0)               # [L, b, kvh, d]
+        vn = jnp.stack(new_v, axis=0)
+        k_pages = k_pages.at[:, page_idx, offset].set(kn)
+        v_pages = v_pages.at[:, page_idx, offset].set(vn)
+        return next_tokens, logits, k_pages, v_pages
+
+    return decode

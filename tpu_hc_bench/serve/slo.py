@@ -1,0 +1,188 @@
+"""SLO report: fold ``request``/``serve`` records into latency/goodput
+lines.
+
+Pure record processing — NO jax import, by contract: ``obs summarize``,
+``obs diff``, and ``obs watch`` call into this module, and the obs CLI
+must keep rendering artifacts copied off a TPU VM on a laptop without a
+backend.  The engine uses the same fold on its in-memory records, so
+the driver's final print and the offline summarize agree by
+construction.
+
+Report fields (the serving analog of the training lane's
+goodput/MFU/p50 account):
+
+- **TTFT** p50/p95/p99 — arrival to first generated token (queueing +
+  prefill; the interactivity number).
+- **End-to-end** p50/p95/p99 — arrival to retirement.
+- **tokens/s** — generated tokens over wall (the serving throughput
+  headline).
+- **goodput-under-load** — the fraction of wall spent on *useful*
+  compute: each step's wall is credited at ``active_rows /
+  bucket_rows`` (padding slots waste it) and idle waits credit
+  nothing.  Static batching loses goodput twice — idling while a
+  batch fills, and padding while stragglers finish — which is exactly
+  the delta continuous batching exists to close.
+- **queue depth** mean/max — the backpressure signal.
+"""
+
+from __future__ import annotations
+
+SERVE_SUMMARY_KIND = "serve_summary"
+REQUEST_KIND = "request"
+
+# (label, key) rows shared by the summarize section and the diff table
+DIFF_METRICS = (
+    ("p99 ttft ms", "p99_ttft_ms"),
+    ("p99 e2e ms", "p99_e2e_ms"),
+    ("p50 e2e ms", "p50_e2e_ms"),
+    ("tokens/s", "tokens_per_s"),
+    ("serve goodput", "goodput"),
+    ("queue max", "queue_depth_max"),
+)
+
+
+def percentile(values: list[float], q: float) -> float:
+    """Linear-interpolated percentile (numpy convention) without the
+    numpy import — this module renders on artifact-only machines."""
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    if len(xs) == 1:
+        return float(xs[0])
+    pos = (q / 100.0) * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return float(xs[lo] * (1.0 - frac) + xs[hi] * frac)
+
+
+def fold_requests(request_records: list[dict]) -> dict:
+    """Percentile block from per-request records (engine-side and
+    offline callers share it)."""
+    out: dict = {}
+    for field in ("ttft_ms", "e2e_ms", "queue_ms"):
+        vals = [float(r[field]) for r in request_records
+                if isinstance(r.get(field), (int, float))]
+        for q in (50, 95, 99):
+            out[f"p{q}_{field}"] = round(percentile(vals, q), 3)
+    return out
+
+
+def fold_serve_records(records: list[dict]) -> dict | None:
+    """Fold one metrics stream's serving records, or None when the run
+    has no serving lane (training runs cost one list scan).
+
+    The last ``serve_summary`` record wins (engine-computed goodput and
+    wall); percentiles are recomputed from the ``request`` records so a
+    stream truncated before its summary still reports latencies.
+    """
+    reqs = [r for r in records if r.get("kind") == REQUEST_KIND]
+    summaries = [r for r in records if r.get("kind") == SERVE_SUMMARY_KIND]
+    compiles = [r for r in records if r.get("kind") == "serve_compile"]
+    if not reqs and not summaries:
+        return None
+    fold: dict = {"completed": len(reqs)}
+    if summaries:
+        fold.update(summaries[-1])
+        fold.pop("kind", None)
+    if reqs:
+        fold.update(fold_requests(reqs))
+        fold["completed"] = len(reqs)
+    if compiles:
+        c = compiles[-1]
+        fold.setdefault("post_warmup_compiles",
+                        c.get("post_warmup_compiles"))
+        fold["compile_buckets"] = c.get("buckets")
+        fold["compile_warm"] = c.get("warm")
+    return fold
+
+
+def slo_lines(fold: dict) -> list[str]:
+    """Render the serving section (summarize / the engine's final
+    print; two-space indent matches the other summarize sections)."""
+    lines = [
+        f"  serve: {fold.get('completed', 0)}"
+        + (f"/{fold['requests']}" if fold.get("requests") else "")
+        + f" requests  batching={fold.get('batching', '?')}"
+        + f"  arrival={fold.get('arrival', '?')}"
+        + (f"@{fold.get('arrival_rate')}/s"
+           if fold.get("arrival_rate") else ""),
+    ]
+    if "p50_ttft_ms" in fold:
+        lines.append(
+            f"  ttft ms p50 {fold['p50_ttft_ms']:.1f}  "
+            f"p95 {fold['p95_ttft_ms']:.1f}  "
+            f"p99 {fold['p99_ttft_ms']:.1f}   e2e ms "
+            f"p50 {fold['p50_e2e_ms']:.1f}  "
+            f"p95 {fold['p95_e2e_ms']:.1f}  "
+            f"p99 {fold['p99_e2e_ms']:.1f}")
+    if fold.get("wall_s") is not None:
+        lines.append(
+            f"  {fold.get('tokens', 0)} tokens in "
+            f"{fold['wall_s']:.2f}s wall = "
+            f"{fold.get('tokens_per_s', 0.0):.1f} tok/s   "
+            f"goodput-under-load {fold.get('goodput', 0.0):.1%}   "
+            f"queue depth mean {fold.get('queue_depth_mean', 0.0):.1f} "
+            f"max {fold.get('queue_depth_max', 0)}")
+    if fold.get("buckets"):
+        lines.append(
+            f"  buckets {','.join(str(b) for b in fold['buckets'])} "
+            f"max_in_flight {fold.get('max_in_flight', '?')}  "
+            f"kv {fold.get('kv_pages', '?')} pages x "
+            f"{fold.get('kv_page_size', '?')} tokens  steps "
+            f"prefill {fold.get('prefill_steps', 0)} / decode "
+            f"{fold.get('decode_steps', 0)} / classify "
+            f"{fold.get('classify_steps', 0)}")
+    pwc = fold.get("post_warmup_compiles")
+    if pwc is not None:
+        lines.append(
+            f"  post-warmup compiles: {pwc}"
+            + (" (every bucket warmed at startup)" if pwc == 0 else
+               " — WARNING: shapes lowered mid-traffic"))
+    return lines
+
+
+def _pct(a: float, b: float) -> str:
+    if a:
+        return f"{(b - a) / a:+.1%}"
+    return "new" if b else "-"
+
+
+def serve_diff_lines(fold_a: dict | None, fold_b: dict | None) -> list[str]:
+    """The ``obs diff`` serving rows (empty unless both runs serve)."""
+    if not fold_a or not fold_b:
+        return []
+    lines = ["  serve metrics:"]
+    for label, key in DIFF_METRICS:
+        if key not in fold_a and key not in fold_b:
+            continue
+        va = float(fold_a.get(key) or 0.0)
+        vb = float(fold_b.get(key) or 0.0)
+        lines.append(f"  {label:>14s} {va:12.4g} {vb:12.4g} "
+                     f"{_pct(va, vb):>8s}")
+    if fold_a.get("batching") != fold_b.get("batching"):
+        lines.append(f"  note: batching arm differs: "
+                     f"{fold_a.get('batching')} -> "
+                     f"{fold_b.get('batching')}")
+    return lines
+
+
+def watch_lines(records: list[dict]) -> list[str]:
+    """The live ``obs watch`` serving panel lines: last serve window +
+    latest percentiles over the requests completed so far."""
+    serves = [r for r in records if r.get("kind") == "serve"]
+    fold = fold_serve_records(records)
+    lines: list[str] = []
+    if serves:
+        s = serves[-1]
+        lines.append(
+            f"  serving t={s.get('t', 0.0):.1f}s  queue "
+            f"{s.get('queue_depth', 0)}  in-flight "
+            f"{s.get('in_flight', 0)}  free pages "
+            f"{s.get('free_pages', '?')}  tokens {s.get('tokens', 0)}")
+    if fold and "p99_e2e_ms" in fold and fold.get("completed"):
+        lines.append(
+            f"  {fold['completed']} done  p99 ttft "
+            f"{fold['p99_ttft_ms']:.1f}ms  p99 e2e "
+            f"{fold['p99_e2e_ms']:.1f}ms")
+    return lines

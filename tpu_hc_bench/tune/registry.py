@@ -107,6 +107,10 @@ def promote(journal: dict,
             f"(status {journal.get('status')!r})")
     hardware = hardware or journal["hardware"]
     model = journal["model"]
+    if journal.get("workload", "train") == "serve":
+        # serving rows are lane-keyed: resolve_auto's serve lookup
+        # reads `<model>@serve`, never the training key
+        model = f"{model}@serve"
     rec = best.get("record") or {}
     row = {
         "overrides": dict(best["overrides"]),
@@ -154,12 +158,18 @@ def resolve_auto(cfg) -> str:
     from tpu_hc_bench.flags import BenchmarkConfig
 
     hw = hardware_key()
-    row = lookup(cfg.model, hw)
+    # the serving lane's rows are keyed `<model>@serve` — one member
+    # can hold a tuned row per lane, and a training lookup can never
+    # apply serving knobs (or vice versa)
+    member = (f"{cfg.model}@serve"
+              if getattr(cfg, "workload", "train") == "serve"
+              else cfg.model)
+    row = lookup(member, hw)
     if row is None:
         cfg.config_source = "baseline"
         have = sorted(load_rows(hw))
         return (f"auto->BASELINE defaults: no tuned row for "
-                f"{cfg.model!r} at hardware {hw!r} "
+                f"{member!r} at hardware {hw!r} "
                 f"({registry_path(hw)}"
                 + (f" has {', '.join(have)}" if have
                    else " does not exist")
@@ -174,6 +184,11 @@ def resolve_auto(cfg) -> str:
             return k in explicit
         return getattr(cfg, k) != defaults.get(k)
 
+    from tpu_hc_bench.tune.space import LEVERS, SERVE_LEVERS
+
+    lane_levers = (SERVE_LEVERS
+                   if getattr(cfg, "workload", "train") == "serve"
+                   else LEVERS)
     applied, kept = [], []
     for k, v in {**row.get("base", {}), **row["overrides"]}.items():
         if not hasattr(cfg, k):
@@ -182,14 +197,20 @@ def resolve_auto(cfg) -> str:
             # loud gate for this
             kept.append(f"{k} (unknown flag)")
             continue
+        if k in (LEVERS + SERVE_LEVERS) and k not in lane_levers:
+            # a lane-crossed row (e.g. a hand-edited @serve row
+            # spelling a training lever) — applying it would smuggle
+            # the other lane's knob past resolve()'s validity matrix
+            kept.append(f"{k} (not a {cfg.workload}-lane lever)")
+            continue
         if not pinned(k):
             setattr(cfg, k, v)
             applied.append(f"{k}={v}")
         else:
             kept.append(f"{k}={getattr(cfg, k)} (explicit flag wins)")
     cfg.config_source = "auto"
-    cfg.tuned_config = {"hardware": hw, "model": cfg.model, **row}
-    note = (f"auto->tuned row {cfg.model}@{hw} "
+    cfg.tuned_config = {"hardware": hw, "model": member, **row}
+    note = (f"auto->tuned row {member}@{hw} "
             f"(score {row.get('score')}): "
             + (", ".join(applied) if applied else "no field changed"))
     if kept:

@@ -167,6 +167,13 @@ def run_search(
         journal = {
             "version": JOURNAL_VERSION,
             "model": model,
+            # the lane travels with the journal: promote() keys a serve
+            # search's registry row `<model>@serve`, the key the
+            # serving lane's --config=auto lookup reads — a bare-keyed
+            # row would de-tune serving silently AND clobber the
+            # member's training row
+            "workload": (candidates[0].workload if candidates
+                         else "train"),
             "hardware": hardware,
             "mode": settings.mode,
             "space_size": len(candidates),
@@ -337,4 +344,5 @@ def _candidate_from_journal(model: str, journal: dict,
     search whose space enumeration changed still honors the journal)."""
     rec = journal["candidates"][key]
     return Candidate.make(model, dict(rec["overrides"]),
-                          dict(rec["base"]))
+                          dict(rec["base"]),
+                          workload=journal.get("workload", "train"))

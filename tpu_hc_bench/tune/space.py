@@ -37,6 +37,8 @@ from tpu_hc_bench.flags import (
 __all__ = [
     "Candidate", "SEED_CONFIGS", "seed_candidate", "member_space",
     "seed_matrix", "seed_extra_flags", "LEVERS",
+    "SERVE_LEVERS", "SEED_SERVE_CONFIGS", "serve_seed_candidate",
+    "serve_member_space",
 ]
 
 # The lever fields a candidate may override (everything else rides the
@@ -49,6 +51,21 @@ LEVERS = (
     "scan_layers",
     "fusion_threshold_bytes",
     "variable_update",
+)
+
+# The serving lane's levers (round 16, ``tpu_hc_bench.serve``): the
+# decode bucket ladder, the continuous-batching admission cap, and the
+# paged-KV geometry.  All are BenchmarkConfig fields, so the halving
+# search, the journal, and ``--config=auto`` handle serve candidates
+# with the same machinery — a serve candidate just carries
+# ``workload="serve"`` so the pruner's flag-time resolve() runs the
+# serving validity matrix, and its registry row is keyed
+# ``<model>@serve`` (one member can hold a tuned row per lane).
+SERVE_LEVERS = (
+    "serve_buckets",
+    "max_in_flight",
+    "kv_page_size",
+    "kv_pages",
 )
 
 # member -> best-known single-chip config (BASELINE.md zoo table).
@@ -104,6 +121,23 @@ SEED_CONFIGS: dict[str, dict] = {
     "deepspeech2":      {"batch": 256},
 }
 
+# member -> best-known SERVING config (the serve lane's seed points;
+# decoder members only — classify members serve single-forward requests
+# whose only lever is the batch-bucket cap).  Values are starting
+# points, not measurements: BASELINE.md grows a "Serving" table as the
+# serve searches land.
+SEED_SERVE_CONFIGS: dict[str, dict] = {
+    "trivial":      {"max_in_flight": 8},
+    "moe_tiny":     {"max_in_flight": 8},
+    "llama_tiny":   {"max_in_flight": 8},
+    "gpt2":         {"max_in_flight": 16},
+    "gpt2_medium":  {"max_in_flight": 8},
+    "gpt2_moe":     {"max_in_flight": 8},
+    "llama_1b":     {"max_in_flight": 4},
+}
+
+_KV_PAGE_LADDER = (8, 16, 32)
+
 _ACCUM_LADDER = (1, 2, 4, 8, 16, 32, 64)
 _FUSION_LADDER = (DEFAULT_FUSION_THRESHOLD_BYTES,
                   DEFAULT_FUSION_THRESHOLD_BYTES // 4)
@@ -119,22 +153,28 @@ class Candidate:
     ``overrides`` maps BenchmarkConfig field names to lever values;
     ``base`` carries the member-fixed flags the search does not move
     (e.g. ``attention_impl=flash`` for the decoder families).
+    ``workload`` selects the lane — a ``"serve"`` candidate draws from
+    ``SERVE_LEVERS`` and resolves under the serving validity matrix.
     """
 
     model: str
     overrides: tuple[tuple[str, object], ...]   # sorted, hashable
     base: tuple[tuple[str, object], ...] = ()
+    workload: str = "train"
 
     @staticmethod
-    def make(model: str, overrides: dict, base: dict | None = None
-             ) -> "Candidate":
+    def make(model: str, overrides: dict, base: dict | None = None,
+             workload: str = "train") -> "Candidate":
+        levers = SERVE_LEVERS if workload == "serve" else LEVERS
         for k in overrides:
-            if k not in LEVERS:
-                raise ValueError(f"not a tunable lever: {k!r}")
+            if k not in levers:
+                raise ValueError(
+                    f"not a tunable lever ({workload} lane): {k!r}")
         return Candidate(
             model=model,
             overrides=tuple(sorted(overrides.items())),
             base=tuple(sorted((base or {}).items())),
+            workload=workload,
         )
 
     @property
@@ -158,8 +198,10 @@ class Candidate:
     def to_config(self, **extra) -> BenchmarkConfig:
         """An UNresolved BenchmarkConfig with this candidate applied
         (the pruner calls ``.resolve()`` on it to get flag-time
-        rejections for free)."""
+        rejections for free — serve candidates under the serving
+        validity matrix)."""
         kwargs = dict(self.all_overrides())
+        kwargs.setdefault("workload", self.workload)
         kwargs.update(extra)
         return BenchmarkConfig(model=self.model, **kwargs)
 
@@ -302,6 +344,77 @@ def member_space(model: str, mode: str = "axes",
                         DEFAULT_FUSION_THRESHOLD_BYTES):
             vary(fusion_threshold_bytes=ft)
     vary(variable_update="zero1")
+    return out
+
+
+def serve_seed_candidate(model: str) -> Candidate:
+    """The member's seeded serving config as a workload="serve"
+    Candidate (identity point of the serve search space)."""
+    seed = SEED_SERVE_CONFIGS.get(model)
+    if seed is None:
+        raise ValueError(
+            f"no seeded serving config for {model!r} (decoder/classify "
+            f"members only; see SEED_SERVE_CONFIGS)")
+    overrides = {k: v for k, v in seed.items() if k != "base"}
+    return Candidate.make(model, overrides, seed.get("base"),
+                          workload="serve")
+
+
+def serve_member_space(model: str,
+                       seed: Candidate | None = None) -> list[Candidate]:
+    """Enumerate the member's serving candidates, seed first (the
+    axes-mode discipline of ``member_space``: one lever at a time off
+    the seed).
+
+    Levers: the admission cap (``max_in_flight`` power-of-two ladder —
+    more rows per decode step vs deeper queues), the KV page size
+    (coarser pages waste tail tokens, finer pages widen the gather
+    tables), the pool size (auto vs a half pool — queueing for pages vs
+    HBM held), and the bucket ladder shape (the full power-of-two
+    ladder vs one top-bucket — per-compile cost vs padding waste).
+    Structural validity beyond this is ``resolve()``'s serving matrix,
+    reached by the pruner's flag-time check.
+    """
+    seed = seed or serve_seed_candidate(model)
+    if seed.workload != "serve":
+        raise ValueError(f"serve_member_space needs a serve-lane seed: "
+                         f"{seed.workload!r}")
+    sd = dict(seed.overrides)
+    base = dict(seed.base)
+    cap = int(sd.get("max_in_flight", _CONFIG_DEFAULTS["max_in_flight"]))
+    page = int(sd.get("kv_page_size", _CONFIG_DEFAULTS["kv_page_size"]))
+
+    out: list[Candidate] = [seed]
+    seen = {seed.key}
+
+    def vary(**delta):
+        o = dict(sd)
+        for k, v in delta.items():
+            if v is None:
+                o.pop(k, None)
+            else:
+                o[k] = v
+        c = Candidate.make(model, o, base, workload="serve")
+        if c.key not in seen:
+            seen.add(c.key)
+            out.append(c)
+
+    for m in _pow2_ladder(cap, down=1, up=2):
+        vary(max_in_flight=m)
+    for p in _KV_PAGE_LADDER:
+        if p != page:
+            vary(kv_page_size=p)
+    # one top bucket: a single compiled decode shape, every step padded
+    # to the cap (the compile-count-vs-padding tradeoff made explicit)
+    vary(serve_buckets=str(cap))
+    # half pool: enough pages for cap/2 worst-case requests + the trash
+    # page — admission blocks on pages instead of slots (queueing-for-
+    # memory, the vLLM regime), trading HBM held for queue delay
+    max_ctx = (_CONFIG_DEFAULTS["max_prompt_len"]
+               + _CONFIG_DEFAULTS["max_output_len"])
+    width = -(-max_ctx // page)
+    half = 1 + max(1, cap // 2) * width
+    vary(kv_pages=half)
     return out
 
 
