@@ -21,6 +21,24 @@ import sys
 REFERENCE_NODE_IMAGES_PER_SEC = 85.0
 
 
+def _maybe_regress(payload: dict) -> int:
+    """``BENCH_REGRESS=1``: gate the exit code on the noise-aware
+    regression check (``obs.regress``) after the JSON line is printed —
+    the fresh record vs the median/MAD of the matching-fingerprint
+    history (``BENCH_HISTORY`` sources, default ``BENCH_*.json`` +
+    ``artifacts/`` in the cwd).  Opt-in: a plain bench run never reads
+    history."""
+    if os.environ.get("BENCH_REGRESS") != "1":
+        return 0
+    from tpu_hc_bench.obs import regress as regress_mod
+
+    specs = None
+    hist = os.environ.get("BENCH_HISTORY")
+    if hist:
+        specs = [s for s in hist.split(os.pathsep) if s]
+    return regress_mod.run_regress(payload, specs, out=sys.stderr)
+
+
 def _serve_main() -> int:
     """``BENCH_WORKLOAD=serve``: the serving-lane headline — one
     continuous-batching run of the round-16 engine at a fixed Poisson
@@ -54,7 +72,7 @@ def _serve_main() -> int:
     summary = serve_cli.run_serve(
         engine, requests, serve_cli.serve_writer(cfg, cfg.metrics_dir))
     manifest = obs_metrics.run_manifest(cfg=cfg)
-    print(json.dumps({
+    payload = {
         "metric": f"{cfg.model}_serve_tokens_per_s",
         "value": summary["tokens_per_s"],
         "unit": "tokens/sec",
@@ -80,8 +98,11 @@ def _serve_main() -> int:
             "tuned_config": cfg.tuned_config,
         },
         "manifest": obs_metrics.manifest_subset(manifest),
-    }))
-    return 0 if summary["completed"] > 0 else 1
+    }
+    print(json.dumps(payload))
+    if summary["completed"] == 0:
+        return 1
+    return _maybe_regress(payload)
 
 
 def main() -> int:
@@ -192,7 +213,7 @@ def main() -> int:
             manifest = json.load(f)
     else:
         manifest = obs_metrics.run_manifest(cfg=cfg)
-    print(json.dumps({
+    payload = {
         "metric": f"{cfg.model}_synthetic_images_per_sec_per_chip",
         "value": round(result.images_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
@@ -253,8 +274,9 @@ def main() -> int:
             "tuned_config": cfg.tuned_config,
         },
         "manifest": obs_metrics.manifest_subset(manifest),
-    }))
-    return 0
+    }
+    print(json.dumps(payload))
+    return _maybe_regress(payload)
 
 
 if __name__ == "__main__":

@@ -635,3 +635,14 @@ def test_emergency_save_writes_memory_dump_subprocess(tmp_path):
     # the emergency path also sampled the ledger under its own phase
     assert any(r.get("kind") == "memory"
                and r.get("phase") == "emergency_save" for r in recs)
+    # round 17: the TIME forensics twin lands beside the memory dump —
+    # timeline_dump.json with this rank's last-K spans, journaled too
+    from tpu_hc_bench.obs import timeline as timeline_mod
+
+    tdump = json.loads(
+        (Path(mdir) / timeline_mod.TIMELINE_DUMP_NAME).read_text())
+    assert tdump["reason"] == "emergency_save"
+    spans0 = tdump["ranks"]["0"]
+    assert spans0 and any(s["name"] == "step_dispatch" for s in spans0)
+    assert any(r.get("kind") == "timeline_dump"
+               and r.get("reason") == "emergency_save" for r in recs)

@@ -49,6 +49,13 @@ shipped) are checked statically:
   is lexical — a probe wrapped in a helper called from the loop is on
   the reviewer — and loop headers (``for a in jax.live_arrays():``,
   the probes' own implementation) are exempt.
+- **span-in-compiled-fn** (error): an ``obs.timeline`` flight-recorder
+  call (``span``/``record_span``/``instant``/``transition``) inside
+  traced code.  The recorder reads the host monotonic clock and stores
+  into a host-side ring; traced, the clock read bakes ONE constant
+  timestamp into the compiled program and the span lies in every
+  execution after the first.  Recorder calls wrap the *dispatch* of
+  compiled work (the driver/serve-engine idiom), never live inside it.
 - **sharding-consistency** (warning): per model, the Megatron
   annotation table (``train.step.tp_param_spec``) is replayed against
   the abstractly-initialized param tree: a rule whose *name* matches a
@@ -87,8 +94,9 @@ INPUT_POOL = "input-pool-width"
 TUNED_STALENESS = "tuned-config-staleness"
 HOT_MEMORY = "memory-probe-in-hot-loop"
 SERVE_RECOMPILE = "serve-bucket-recompile"
+SPAN_IN_JIT = "span-in-compiled-fn"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
-                    INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE)
+                    INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE, SPAN_IN_JIT)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -650,6 +658,62 @@ class _FileLinter:
                 return True
         return False
 
+    # -- pass: flight-recorder calls inside traced code ----------------
+
+    # obs.timeline's recorder surface: host-clock reads + ring stores —
+    # traced into a jit/AOT program they bake ONE constant timestamp at
+    # trace time (and the span never measures anything again), exactly
+    # the silent-lie class the recorder's host-side contract forbids
+    _SPAN_CALLEES = {"record_span", "instant", "transition",
+                     "dump_timeline"}
+    _SPAN_MODULE_HINTS = ("timeline", "recorder", "flight")
+
+    @functools.cached_property
+    def _timeline_imported_names(self) -> set[str]:
+        """Local names bound by ``from ...obs.timeline import X [as Y]``
+        — a bare ``transition(...)`` call through such a binding is the
+        recorder's even when no dotted prefix betrays it."""
+        out: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.rsplit(".", 1)[-1] == "timeline":
+                out.update(a.asname or a.name for a in node.names)
+        return out
+
+    def _check_span_in_jit(self, ctx: ast.AST):
+        """**span-in-compiled-fn** (error): an ``obs.timeline`` recorder
+        call (``span``/``record_span``/``instant``/``transition``)
+        inside a traced function.  The recorder reads the HOST monotonic
+        clock; under trace that read happens once, at trace time, so the
+        compiled program carries a frozen timestamp — the span lies
+        forever and recompile-guards can't save it.  Record around the
+        dispatch (the driver's idiom), never inside it."""
+        for node in ast.walk(ctx):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            base = name.rsplit(".", 1)[-1]
+            timeline_owned = (
+                any(h in name.lower() for h in self._SPAN_MODULE_HINTS)
+                # a BARE call through `from ...timeline import X [as Y]`
+                # is the recorder's even with no dotted prefix
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in self._timeline_imported_names))
+            if not (base in self._SPAN_CALLEES
+                    or (base == "span" and timeline_owned)):
+                continue
+            if base not in ("record_span", "dump_timeline") \
+                    and not timeline_owned:
+                continue    # a generic .instant()/.transition() that is
+                            # not the flight recorder's
+            self._emit(
+                SPAN_IN_JIT, "error", node,
+                f"flight-recorder call `{name}(...)` inside traced "
+                f"`{getattr(ctx, 'name', '?')}` — the host-clock read "
+                "traces to ONE constant timestamp and the span lies in "
+                "every execution; record around the jitted call, not "
+                "inside it (obs.timeline is host-side by contract)")
+
     # -- serve-bucket-recompile ----------------------------------------
 
     # calls that lower/trace a program (and so can compile a NEW shape):
@@ -709,6 +773,7 @@ class _FileLinter:
         for ctx in self._jit_contexts():
             self._check_host_sync(ctx)
             self._check_recompile(ctx)
+            self._check_span_in_jit(ctx)
         self._check_donation()
         self._check_checkpoint_topology()
         self._check_input_pool()

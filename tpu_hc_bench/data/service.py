@@ -68,6 +68,8 @@ from typing import Callable, Iterator, Sequence
 
 import numpy as np
 
+from tpu_hc_bench.obs import timeline as timeline_mod
+
 __all__ = [
     "ArraySpec", "BatchLayout", "ShmRing", "InputService", "ServiceClient",
     "image_batch_layout", "packed_token_layout", "make_image_service",
@@ -523,14 +525,29 @@ class InputService:
         ring = self.rings[w]
         gen = self._make_stream(w)
         try:
-            for batch in gen:
-                if not ring.put(batch, stop=self._stop):
+            while True:
+                # flight-recorder spans (obs.timeline): decode (the
+                # stream's next() — parse + jpeg decode + augment) vs
+                # ring_put (copy + any ring-full stall), one span per
+                # batch — a starved consumer vs a stalled producer read
+                # straight off the feeder's timeline
+                t0 = time.monotonic()
+                try:
+                    batch = next(gen)
+                except StopIteration:
+                    ring.close_producer()   # finite stream drained cleanly
+                    return
+                t_put = time.monotonic()
+                timeline_mod.record_span("svc_decode", t0, t_put, worker=w)
+                ok = ring.put(batch, stop=self._stop)
+                timeline_mod.record_span("ring_put", t_put,
+                                         time.monotonic(), worker=w)
+                if not ok:
                     # service stopping: still mark the stream closed so
                     # a consumer blocked in get() unblocks instead of
                     # polling a dead ring forever
                     ring.close_producer()
                     return
-            ring.close_producer()       # finite stream drained cleanly
         except Exception:
             self.errors.append(
                 f"worker {w} stream: {traceback.format_exc()}")
@@ -612,7 +629,10 @@ class ServiceClient:
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, ...]]:
         while True:
+            t0 = time.monotonic()
             views = self.ring.get(timeout=self.stall_timeout_s)
+            timeline_mod.record_span("ring_get", t0, time.monotonic(),
+                                     worker=self.worker)
             if views is None:
                 if not int(self.ring._hdr[_H_CLOSED]):
                     raise RuntimeError(

@@ -263,6 +263,18 @@ class BenchmarkConfig:
                                               # + per-host heartbeat files
                                               # metrics.<k>.jsonl (obs.fleet;
                                               # every process writes its own)
+    flight_recorder: str = "on"               # on|off: the always-on host
+                                              # span recorder (obs.timeline)
+                                              # — bounded in-memory ring on
+                                              # every run; with --metrics_dir
+                                              # each rank also persists
+                                              # spans.<k>.jsonl and the
+                                              # watchdog/OOM/preempt paths
+                                              # drop timeline_dump.json.
+                                              # "off" is the bare-benchmark
+                                              # paranoia switch (measured
+                                              # overhead is <1% of a
+                                              # steady-state step)
     fabric_ceiling: str | None = None         # measured-fabric sweep JSON
                                               # (microbench.osu --json): the
                                               # run judges its achieved
@@ -663,6 +675,11 @@ class BenchmarkConfig:
         if self.workload not in ("train", "serve"):
             raise ValueError(
                 f"workload must be train|serve: {self.workload!r}")
+        if self.flight_recorder not in ("on", "off"):
+            # shared by both lanes, so validated before the serve branch
+            raise ValueError(
+                f"--flight_recorder must be on|off: "
+                f"{self.flight_recorder!r}")
         if self.workload == "serve":
             # the serving lane (round 16): its own validity matrix, and
             # none of the training-lane translations/duration defaults
@@ -1171,6 +1188,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile_steps", type=str, default=None,
                    metavar="A:B")
     p.add_argument("--metrics_dir", type=str, default=None)
+    p.add_argument("--flight_recorder", type=str,
+                   default=d.flight_recorder, choices=["on", "off"])
     p.add_argument("--fabric_ceiling", type=str, default=None,
                    metavar="SWEEP_JSON")
     p.add_argument("--hbm_budget", type=str, default=None,

@@ -31,12 +31,23 @@ Where ``analysis`` inspects the *compiled program* (HLO, jaxpr),
   high-water mark is attributed to the goodput phase that set it, OOM/
   emergency forensics (``memory_dump.json``), and the ``--hbm_budget``
   pre-run check.
+- ``obs.timeline`` — the always-on host flight recorder: a bounded
+  preallocated span ring every lane records into (train driver, data
+  service, serve engine, checkpoint), persisted per rank as
+  ``spans.<k>.jsonl``, merged cross-rank (heartbeat clock alignment)
+  into Chrome-trace JSON, and dumped as ``timeline_dump.json`` by the
+  watchdog/OOM/preemption paths — the time forensics twin of
+  ``memory_dump.json``.
+- ``obs.regress`` — the noise-aware regression gate: a fresh BENCH
+  record vs the median/MAD of the matching-config-fingerprint history,
+  direction-aware per metric (throughput down, p99/HBM up).
 - ``python -m tpu_hc_bench.obs`` — ``summarize`` renders either
   artifact kind (a metrics run or a raw trace directory); ``diff``
   compares two runs at bucket/metric granularity, so a regression
   reads "collective +40%, compute flat" instead of a single throughput
   delta; ``watch`` tails a live run in place and exits when it
-  completes.
+  completes; ``timeline`` writes the merged cross-rank Chrome trace;
+  ``regress`` runs the history gate (exit 1 on a real regression).
 """
 
 from tpu_hc_bench.obs import metrics, trace  # noqa: F401

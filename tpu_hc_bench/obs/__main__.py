@@ -1,9 +1,19 @@
-"""CLI: ``python -m tpu_hc_bench.obs`` — summarize / diff / watch runs.
+"""CLI: ``python -m tpu_hc_bench.obs`` — summarize / diff / watch /
+timeline / regress.
 
 Examples::
 
     # render a metrics run (dir with metrics.jsonl + manifest.json)
     python -m tpu_hc_bench.obs summarize /runs/r50_bs128
+
+    # merge every rank's flight-recorder spans into ONE aligned
+    # Chrome-trace file (open in chrome://tracing or Perfetto)
+    python -m tpu_hc_bench.obs timeline /runs/r50_bs128
+
+    # noise-aware regression gate: fresh BENCH json vs the history's
+    # median/MAD per config fingerprint (exit 1 on a real regression)
+    python -m tpu_hc_bench.obs regress BENCH_fresh.json \
+        --history 'BENCH_*.json'
 
     # ... judging collective bandwidth against a measured fabric sweep
     python -m tpu_hc_bench.obs summarize /runs/r50_bs128 \
@@ -130,6 +140,29 @@ def main(argv: list[str] | None = None, out=None) -> int:
                    help="give up (exit 1) after this many seconds")
     w.add_argument("--no-follow", dest="follow", action="store_false",
                    help="render one snapshot and exit")
+    t = sub.add_parser("timeline",
+                       help="merge every rank's flight-recorder spans "
+                            "(spans.<k>.jsonl) into one clock-aligned "
+                            "Chrome-trace JSON")
+    t.add_argument("run_dir")
+    t.add_argument("-o", "--out", default=None, metavar="TRACE_JSON",
+                   help="output path (default <run_dir>/"
+                        "timeline.trace.json)")
+    r = sub.add_parser("regress",
+                       help="noise-aware regression gate: a fresh BENCH "
+                            "json vs the history's median/MAD per config "
+                            "fingerprint; exit 1 on regression")
+    r.add_argument("fresh", help="fresh BENCH json (bare record or the "
+                                 "harness {'parsed': ...} wrapper)")
+    r.add_argument("--history", nargs="+", default=None,
+                   metavar="FILE|DIR|GLOB",
+                   help="history sources (default: BENCH_*.json + "
+                        "artifacts/ in the cwd)")
+    r.add_argument("--mad_k", type=float, default=None,
+                   help="noise multiplier on the MAD-sigma (default 4)")
+    r.add_argument("--rel_floor", type=float, default=None,
+                   help="relative noise floor vs the median (default "
+                        "0.03: a quiet history never flags <3%% jitter)")
     args = ap.parse_args(argv)
     out = out or sys.stdout
     try:
@@ -138,6 +171,27 @@ def main(argv: list[str] | None = None, out=None) -> int:
                               fabric_ceiling=args.fabric_ceiling)
         if args.cmd == "diff":
             return _diff(args.run_a, args.run_b, out)
+        if args.cmd == "timeline":
+            from tpu_hc_bench.obs import timeline as timeline_mod
+
+            path = timeline_mod.write_chrome_trace(args.run_dir,
+                                                   out_path=args.out)
+            for ln in timeline_mod.timeline_lines(args.run_dir):
+                print(ln.strip(), file=out)
+            print(f"chrome trace written: {path} (open in "
+                  f"chrome://tracing or https://ui.perfetto.dev)",
+                  file=out)
+            return 0
+        if args.cmd == "regress":
+            from tpu_hc_bench.obs import regress as regress_mod
+
+            kwargs = {}
+            if args.mad_k is not None:
+                kwargs["mad_k"] = args.mad_k
+            if args.rel_floor is not None:
+                kwargs["rel_floor"] = args.rel_floor
+            return regress_mod.run_regress(args.fresh, args.history,
+                                           out=out, **kwargs)
         from tpu_hc_bench.obs import watch as watch_mod
 
         return watch_mod.watch(args.path, out=out, interval=args.interval,

@@ -66,6 +66,34 @@ class StepEwma:
         return self.ewma_ms
 
 
+def _next_incarnation(path: str) -> int:
+    """This life's incarnation counter: one more than the last record's
+    in the existing heartbeat file (0 for a fresh file).  Reads only the
+    file tail — heartbeat files grow O(run) and this runs at startup."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    if size == 0:
+        return 0
+    try:
+        with open(path, "rb") as f:
+            f.seek(max(0, size - 8192))
+            tail = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return 0
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        return int(rec.get("incarnation", 0) or 0) + 1
+    return 1    # non-empty file with no parseable tail: still a relaunch
+
+
 class FleetWriter:
     """Append-only heartbeat stream for THIS process.
 
@@ -73,11 +101,22 @@ class FleetWriter:
     disabled (no-op) when ``out_dir`` is falsy.  Each heartbeat is
     flushed immediately — the file must be readable while the run is
     live, and a killed process must not lose its last sign of life.
+
+    The file opens in APPEND mode: an elastic resume into the same run
+    dir must extend the prior life's history, not truncate it (the
+    pre-round-17 ``"w"`` open silently erased every heartbeat the
+    crashed incarnation left behind — exactly the forensics a resume
+    postmortem needs).  Each record carries an ``incarnation`` counter
+    (0 for the first life, +1 per relaunch) so readers can tell the
+    lives apart, and a ``t_mono`` stamp pairing the wall clock with
+    this process's monotonic clock — the span-timeline merge's
+    per-rank clock-alignment source (``obs.timeline``).
     """
 
     def __init__(self, out_dir: str | None, process_index: int | None = None):
         self._f = None
         self.process_index = 0
+        self.incarnation = 0
         if not out_dir:
             return
         if process_index is None:
@@ -86,7 +125,9 @@ class FleetWriter:
             process_index = jax.process_index()
         self.process_index = process_index
         os.makedirs(out_dir, exist_ok=True)
-        self._f = open(heartbeat_path(out_dir, process_index), "w")
+        path = heartbeat_path(out_dir, process_index)
+        self.incarnation = _next_incarnation(path)
+        self._f = open(path, "a")
 
     @property
     def enabled(self) -> bool:
@@ -98,7 +139,8 @@ class FleetWriter:
             return
         rec = {"kind": "heartbeat", "host": self.process_index,
                "step": int(step), "step_ewma_ms": float(step_ewma_ms),
-               "t_unix": time.time()}
+               "t_unix": time.time(), "t_mono": time.monotonic(),
+               "incarnation": self.incarnation}
         if mem_peak_bytes:
             # the ONE heartbeat memory field name — readers
             # (watch/summarize) consume it via heartbeat_mem_peak

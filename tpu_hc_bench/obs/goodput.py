@@ -57,6 +57,8 @@ from __future__ import annotations
 import dataclasses
 import time
 
+from tpu_hc_bench.obs import timeline as timeline_mod
+
 PHASES = ("init", "compile", "step", "data_wait", "checkpoint",
           "checkpoint_async", "rewind_replay", "emergency_save", "idle")
 END_PHASE = "end"
@@ -89,6 +91,10 @@ class PhaseTracker:
 
     def enter(self, phase: str, step: int | None = None) -> None:
         self._emit("phase", phase=phase, t=time.monotonic(), step=step)
+        # mirror the transition into the flight recorder's coarse lane
+        # (obs.timeline): the ledger gets seconds, the timeline gets the
+        # same spans per rank — one call site, two consumers
+        timeline_mod.transition(phase, step=step)
 
     def note_data_wait(self, seconds: float) -> None:
         self._data_wait_acc += seconds
@@ -108,6 +114,7 @@ class PhaseTracker:
     def end(self, step: int | None = None) -> None:
         self.flush(step)
         self._emit("phase", phase=END_PHASE, t=time.monotonic(), step=step)
+        timeline_mod.transition(END_PHASE, step=step)
 
     def ledger(self) -> "Ledger | None":
         """The ledger over everything emitted so far (driver-side path;

@@ -463,6 +463,12 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
         lines.append(
             f"  memory dump: {dump.get('path')} "
             f"(reason {dump.get('reason')}, step {dump.get('step')})")
+    # flight-recorder timeline (obs.timeline): per-rank span totals with
+    # the dominant waits, the cross-rank bubble, and any
+    # timeline_dump.json forensics the run left behind
+    from tpu_hc_bench.obs import timeline as timeline_mod
+
+    lines.extend(timeline_mod.timeline_lines(run_dir))
     resume = _last(records, "resume")
     if resume:
         # elastic-resume identity: a post-resume throughput shift with a

@@ -1,0 +1,217 @@
+"""Noise-aware regression gate over the BENCH-json history.
+
+``obs diff`` answers "what changed between these two runs"; this module
+answers the CI question "is this fresh number a *real* regression
+against everything we have measured before" — without a human picking
+the comparison run, and without a fixed percentage threshold that
+either cries wolf on a noisy metric or sleeps through a drift on a
+quiet one.
+
+Mechanism: prior BENCH records (the one-line JSON ``bench.py`` prints,
+or the ``{"parsed": ...}`` wrapper the driver harness saves as
+``BENCH_*.json``) are grouped by **config fingerprint** — metric name
+plus the identity fields that make numbers comparable (global batch,
+chips, dtype, gradient arm, device kind).  For each checked metric the
+history's **median** is the center and its **MAD** (median absolute
+deviation, scaled by 1.4826 to a sigma equivalent) is the noise scale;
+a fresh value regresses when it is worse than the median by more than
+``max(mad_k * sigma, rel_floor * |median|)`` — the MAD term adapts to
+each metric's own run-to-run noise, the relative floor keeps a
+perfectly-quiet history (MAD 0) from flagging measurement jitter.
+Direction is per metric: throughput/goodput regress DOWN, latency
+p99s and HBM peaks regress UP.  An unchanged rerun always passes
+(delta 0 < any threshold); improvements never flag.
+
+Wire-in: ``python -m tpu_hc_bench.obs regress fresh.json --history
+'BENCH_*.json'`` (exit 0 pass / 1 regression / 2 unusable input), and
+``BENCH_REGRESS=1`` makes ``bench.py`` gate its own exit code on the
+check after printing the JSON line.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import statistics
+
+#: metric spec: (record path, direction, label).  "higher" = regression
+#: is a DROP below the history median; "lower" = a RISE above it.
+CHECKS = (
+    (("value",), "higher", "headline"),
+    (("extra", "goodput"), "higher", "goodput"),
+    (("extra", "tokens_per_s"), "higher", "tokens/s"),
+    (("extra", "p99_ms"), "lower", "p99 e2e ms"),
+    (("extra", "p99_ttft_ms"), "lower", "p99 ttft ms"),
+    (("extra", "peak_hbm_bytes"), "lower", "peak HBM bytes"),
+)
+
+#: identity fields folded into the fingerprint (record path order)
+FINGERPRINT_KEYS = (
+    ("metric",), ("unit",),
+    ("extra", "global_batch"), ("extra", "chips"), ("extra", "dtype"),
+    ("extra", "variable_update"), ("extra", "batching"),
+    ("extra", "arrival_rate"),
+    ("manifest", "device_kind"), ("manifest", "process_count"),
+)
+
+DEFAULT_MAD_K = 4.0
+DEFAULT_REL_FLOOR = 0.03
+
+
+def _get(rec: dict, path: tuple[str, ...]):
+    cur = rec
+    for k in path:
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(k)
+    return cur
+
+
+def load_bench_record(path: str) -> dict | None:
+    """A BENCH record from any of its on-disk shapes: the bare JSON
+    line, the harness wrapper (``{"parsed": {...}, "tail": "..."}``), or
+    a tail whose last JSON-looking line is the record.  None when
+    nothing parses — the caller reports, never raises."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    if "metric" in data and "value" in data:
+        return data
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    tail = data.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "metric" in rec:
+                    return rec
+    return None
+
+
+def fingerprint(rec: dict) -> tuple:
+    return tuple(_get(rec, path) for path in FINGERPRINT_KEYS)
+
+
+def load_history(specs: list[str],
+                 exclude: str | None = None) -> list[tuple[str, dict]]:
+    """Expand history specs (files, dirs, globs) into parsed records.
+    A dir means every ``*.json`` directly under it; ``exclude`` drops
+    the fresh record's own path so a gate never compares a file against
+    itself."""
+    paths: list[str] = []
+    for spec in specs:
+        if os.path.isdir(spec):
+            paths.extend(sorted(glob.glob(os.path.join(spec, "*.json"))))
+        elif any(c in spec for c in "*?["):
+            paths.extend(sorted(glob.glob(spec)))
+        elif os.path.isfile(spec):
+            paths.append(spec)
+    out = []
+    seen = set()
+    excl = os.path.abspath(exclude) if exclude else None
+    for p in paths:
+        ap = os.path.abspath(p)
+        if ap in seen or ap == excl:
+            continue
+        seen.add(ap)
+        rec = load_bench_record(p)
+        if rec is not None:
+            out.append((p, rec))
+    return out
+
+
+def regress_check(fresh: dict, history: list[dict],
+                  mad_k: float = DEFAULT_MAD_K,
+                  rel_floor: float = DEFAULT_REL_FLOOR) -> dict:
+    """The verdict: compare ``fresh`` against same-fingerprint history.
+
+    Returns ``{"checked": [...], "regressions": [...], "history_n": N,
+    "lines": [...]}`` — ``regressions`` non-empty means the gate fails.
+    """
+    fp = fingerprint(fresh)
+    matched = [h for h in history if fingerprint(h) == fp]
+    lines: list[str] = []
+    checked: list[dict] = []
+    regressions: list[dict] = []
+    if not matched:
+        lines.append(
+            f"regress: no history for fingerprint {fresh.get('metric')} "
+            f"(of {len(history)} record(s)) — nothing to gate against")
+        return {"checked": checked, "regressions": regressions,
+                "history_n": 0, "lines": lines}
+    for path, direction, label in CHECKS:
+        v = _get(fresh, path)
+        if not isinstance(v, (int, float)):
+            continue
+        hist = [_get(h, path) for h in matched]
+        hist = [float(x) for x in hist if isinstance(x, (int, float))]
+        if not hist:
+            continue
+        med = statistics.median(hist)
+        sigma = 1.4826 * statistics.median(abs(x - med) for x in hist)
+        threshold = max(mad_k * sigma, rel_floor * abs(med))
+        worse = (med - float(v)) if direction == "higher" \
+            else (float(v) - med)
+        entry = {"metric": label, "value": float(v), "median": med,
+                 "sigma": round(sigma, 6), "threshold": round(threshold, 6),
+                 "delta_worse": round(worse, 6), "n": len(hist),
+                 "direction": direction}
+        checked.append(entry)
+        verdict = "REGRESSION" if worse > threshold else "ok"
+        rel = (worse / abs(med)) if med else 0.0
+        lines.append(
+            f"regress: {label}: {v:.6g} vs median {med:.6g} "
+            f"(n={len(hist)}, sigma {sigma:.3g}, threshold "
+            f"{threshold:.3g}) -> {verdict}"
+            + (f" ({rel:+.1%} worse)" if verdict == "REGRESSION" else ""))
+        if verdict == "REGRESSION":
+            regressions.append(entry)
+    if not checked:
+        lines.append("regress: matched history carries none of the "
+                     "checked metrics — nothing to gate against")
+    return {"checked": checked, "regressions": regressions,
+            "history_n": len(matched), "lines": lines}
+
+
+def run_regress(fresh_path_or_rec, history_specs: list[str] | None,
+                out=None, mad_k: float = DEFAULT_MAD_K,
+                rel_floor: float = DEFAULT_REL_FLOOR) -> int:
+    """CLI/bench entry.  Exit codes: 0 pass (including no-history),
+    1 significant regression, 2 unusable fresh record."""
+    import sys
+
+    out = out or sys.stdout
+    if isinstance(fresh_path_or_rec, dict):
+        fresh, fresh_path = fresh_path_or_rec, None
+    else:
+        fresh_path = fresh_path_or_rec
+        fresh = load_bench_record(fresh_path)
+    if fresh is None:
+        print(f"error: no BENCH record parseable at {fresh_path}",
+              file=out)
+        return 2
+    specs = history_specs or ["BENCH_*.json", "artifacts"]
+    history = [rec for _, rec in load_history(specs, exclude=fresh_path)]
+    verdict = regress_check(fresh, history, mad_k=mad_k,
+                            rel_floor=rel_floor)
+    for ln in verdict["lines"]:
+        print(ln, file=out)
+    if verdict["regressions"]:
+        names = ", ".join(r["metric"] for r in verdict["regressions"])
+        print(f"regress: FAIL — significant regression in: {names}",
+              file=out)
+        return 1
+    print(f"regress: pass ({len(verdict['checked'])} metric(s) against "
+          f"{verdict['history_n']} matching record(s))", file=out)
+    return 0

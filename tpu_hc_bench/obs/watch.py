@@ -73,6 +73,21 @@ def render(path: str, manifest: dict, records: list[dict],
     if len(mem_peaks) > 1:
         lines.append(f"  fleet mem peak: {max(mem_peaks) / 2**20:.1f} MiB "
                      f"max across {len(mem_peaks)} host(s)")
+    # per-rank current phase (round 17): the newest flight-recorder span
+    # each rank stamped into its heartbeat — a hung fleet shows WHERE
+    # each rank is stuck, not just that its step counter stopped
+    last_beats = {h: recs[-1] for h, recs in sorted(beats.items()) if recs}
+    if any(r.get("phase") for r in last_beats.values()):
+        for h, r in list(last_beats.items())[:8]:
+            age = time.time() - r.get("t_unix", time.time())
+            lines.append(
+                f"  rank{h}: step {r.get('step', '?')}  "
+                f"phase {r.get('phase') or '?'}  "
+                f"beat {age:.0f}s ago"
+                + (f"  (incarnation {r['incarnation']})"
+                   if r.get("incarnation") else ""))
+        if len(last_beats) > 8:
+            lines.append(f"  ... {len(last_beats) - 8} more rank(s)")
     ledger = goodput_mod.build_ledger(records)
     if ledger is not None:
         lines.extend("  " + ln for ln in ledger.format_lines())

@@ -46,6 +46,7 @@ import numpy as np
 from tpu_hc_bench.flags import BenchmarkConfig, parse_serve_buckets
 from tpu_hc_bench.obs import efficiency as obs_efficiency
 from tpu_hc_bench.obs import metrics as obs_metrics
+from tpu_hc_bench.obs import timeline as timeline_mod
 from tpu_hc_bench.serve import slo as slo_mod
 from tpu_hc_bench.serve.arrivals import Request
 
@@ -355,10 +356,15 @@ class ServeEngine:
         import jax
 
         c0 = clock.now()
+        m0 = time.monotonic()
         t0 = time.perf_counter()
         out = fn()
         jax.block_until_ready(out)
         clock.charge(kind, time.perf_counter() - t0)
+        # flight recorder (obs.timeline): every engine step kind
+        # (prefill/decode/classify) lands as a span — the serving lane's
+        # always-on host timeline, real wall even under a VirtualClock
+        timeline_mod.record_span(kind, m0, time.monotonic())
         return out, clock.now() - c0
 
     def _classify_input(self, req: Request) -> np.ndarray:
@@ -380,6 +386,11 @@ class ServeEngine:
             raise ValueError(f"batching must be continuous|static: "
                              f"{batching!r}")
         writer = writer or obs_metrics.MetricsWriter(None)
+        # flight recorder: honor --flight_recorder and, on metrics runs,
+        # persist this process's spans beside the stream
+        timeline_mod.configure(
+            enabled=self.cfg.flight_recorder != "off",
+            run_dir=getattr(writer, "out_dir", None))
         clock = clock or MonotonicClock()
         allocator = PageAllocator(self.num_pages) if self.decode_mode \
             else None
@@ -431,12 +442,14 @@ class ServeEngine:
                 rec["generated"] = list(fl.out_tokens)
             done.append(rec)
             writer.event("request", **rec)
+            timeline_mod.instant("retire", rid=fl.req.rid)
             if allocator is not None:
                 allocator.free(fl.pages)
 
         def admit(req: Request) -> None:
             nonlocal kv_k, kv_v, tokens_out, productive_s
             t_admit = now()
+            timeline_mod.instant("admit", rid=req.rid)
             if not self.decode_mode:
                 active.append(_InFlight(req=req, pages=[],
                                         table=np.zeros(0, np.int32),
@@ -604,4 +617,5 @@ class ServeEngine:
         writer.event("serve_compile", **self.compile_record,
                      entries_final=entries_final,
                      post_warmup_compiles=summary["post_warmup_compiles"])
+        timeline_mod.detach()   # flush the serve spans, close the file
         return summary

@@ -27,6 +27,7 @@ from tpu_hc_bench.obs import fleet as obs_fleet
 from tpu_hc_bench.obs import goodput as obs_goodput
 from tpu_hc_bench.obs import memory as obs_memory
 from tpu_hc_bench.obs import metrics as obs_metrics
+from tpu_hc_bench.obs import timeline as timeline_mod
 from tpu_hc_bench.models import create_model
 from tpu_hc_bench.data.synthetic import SyntheticImages, SyntheticTokens
 from tpu_hc_bench.parallel import fabric as fabric_mod
@@ -266,6 +267,7 @@ class _ArrivalFetcher:
         self._keep_value = keep_value or (lambda i: True)
         self.fetched_step = 0
         self.last_arrival_t: float | None = None   # watchdog progress oracle
+        self._last_mono: float | None = None       # device_step span anchor
         self.error: BaseException | None = None
         self._error_tb = None
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -318,6 +320,15 @@ class _ArrivalFetcher:
                 return
             self.arrivals.append((i, time.perf_counter(), v))
             self.last_arrival_t = time.perf_counter()
+            # flight recorder (obs.timeline): the interval between
+            # consecutive completion markers IS the device's view of the
+            # step — recorded from this thread so the dispatch path pays
+            # nothing
+            m_now = time.monotonic()
+            if self._last_mono is not None:
+                timeline_mod.record_span("device_step", self._last_mono,
+                                         m_now, step=i)
+            self._last_mono = m_now
             self.fetched_step = i
 
     def finish(self) -> list[tuple[int, float, object]]:
@@ -703,7 +714,13 @@ def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
                               global_batch)
     timeline.start(loss)        # drained above: arrival stamps t=0
     for i in range(1, cfg.num_batches + 1):
-        loss, correct = eval_step(state, next(batch_iter))
+        t_dw = time.monotonic()
+        batch = next(batch_iter)
+        t_disp = time.monotonic()
+        timeline_mod.record_span("input_wait", t_dw, t_disp, step=i)
+        loss, correct = eval_step(state, batch)
+        timeline_mod.record_span("eval_dispatch", t_disp,
+                                 time.monotonic(), step=i)
         corrects.append(correct)
         timeline.record(i, loss)
     display_recs: list[tuple[int, float, object]] = []
@@ -751,6 +768,7 @@ def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
     obs_writer.event("summary", eval_top_1=correct_total / seen,
                      **result.json_line())
     obs_writer.close()
+    timeline_mod.detach()
     return result
 
 
@@ -1033,6 +1051,13 @@ def run_benchmark(
                  f"python -m tpu_hc_bench.obs watch {cfg.metrics_dir}")
     else:
         obs_writer = obs_metrics.MetricsWriter(None)
+    # flight recorder (obs.timeline): always-on bounded span ring; with
+    # --metrics_dir EVERY rank persists its spans.<k>.jsonl beside the
+    # heartbeats (per-rank visibility, like FleetWriter).  Configured
+    # BEFORE the phase tracker so the init transition lands in the ring.
+    timeline_mod.configure(enabled=cfg.flight_recorder != "off",
+                           run_dir=cfg.metrics_dir,
+                           rank=jax.process_index())
     # goodput ledger (obs.goodput): phase transitions into the metrics
     # stream + a local mirror so the final account never re-reads the
     # file; enters "init" now
@@ -1619,6 +1644,12 @@ def run_benchmark(
                 obs_writer.event("memory_dump",
                                  path=os.path.basename(dpath),
                                  reason="oom")
+            tpath = timeline_mod.dump_timeline(cfg.metrics_dir,
+                                               reason="oom")
+            if tpath:
+                obs_writer.event("timeline_dump",
+                                 path=os.path.basename(tpath),
+                                 reason="oom")
         raise
     warmup_elapsed = time.perf_counter() - t_compile
     print_fn(
@@ -1877,6 +1908,14 @@ def run_benchmark(
                 obs_writer.event("memory_dump",
                                  path=os.path.basename(dpath),
                                  reason="emergency_save", step=completed)
+            # time forensics beside the memory forensics: the last-K
+            # spans per rank — what phase everyone was in at the kill
+            tpath = timeline_mod.dump_timeline(
+                cfg.metrics_dir, reason="emergency_save", step=completed)
+            if tpath:
+                obs_writer.event("timeline_dump",
+                                 path=os.path.basename(tpath),
+                                 reason="emergency_save", step=completed)
         obs_writer.event("preempt", step=completed,
                          signal=preempt_h.signum, checkpoint_saved=saved,
                          world=topo_rec.get("world"),
@@ -1884,6 +1923,7 @@ def run_benchmark(
         phases.end(step=completed)
         obs_writer.close()
         fleet_writer.close()
+        timeline_mod.detach()
         raise preempt_mod.PreemptedError(completed, saved, preempt_h.signum,
                                          topology=topo_rec)
 
@@ -2024,9 +2064,11 @@ def run_benchmark(
                 last_record_fn=lambda: obs_writer.last_record,
                 obs_writer=obs_writer,
                 forensics_fn=(
-                    (lambda: obs_memory.dump_forensics(
+                    (lambda: (obs_memory.dump_forensics(
                         cfg.metrics_dir, reason="watchdog",
-                        print_fn=print_fn))
+                        print_fn=print_fn),
+                        timeline_mod.dump_timeline(
+                            cfg.metrics_dir, reason="watchdog")))
                     if cfg.metrics_dir else None)).start()
             print_fn(f"watchdog armed: step timeout {timeout_s:.1f}s")
         if policy == "rewind":
@@ -2048,15 +2090,23 @@ def run_benchmark(
             trace_window.maybe_start(i, timeline.fetcher)
             t_dw = time.monotonic()
             batch = next(batch_iter)
+            t_dispatch = time.monotonic()
             # host time blocked on the input pipeline — carved out of
             # the "step" phase by the ledger (a cheap float add here;
-            # the jsonl write happens once per sync window)
-            phases.note_data_wait(time.monotonic() - t_dw)
+            # the jsonl write happens once per sync window), and the
+            # same interval recorded as an input_wait span
+            phases.note_data_wait(t_dispatch - t_dw)
+            timeline_mod.record_span("input_wait", t_dw, t_dispatch,
+                                     step=i)
             if plan is not None:
                 plan.fire_step_faults(i, print_fn, obs_writer)
                 batch = plan.poison_batch(i, batch, print_fn, obs_writer)
             state, metrics = train_step(
                 state, batch, jax.random.fold_in(rng, warmup_steps + i))
+            # host-side dispatch cost only (the step itself is async;
+            # device progress is the fetch thread's device_step spans)
+            timeline_mod.record_span("step_dispatch", t_dispatch,
+                                     time.monotonic(), step=i)
             timeline.record(i, metrics["loss"])
             if tracker is not None:
                 tracker.update(metrics["nonfinite"])
@@ -2097,6 +2147,10 @@ def run_benchmark(
                     # host's heartbeat under the unified name
                     obs_writer.event("memory",
                                      **mem_ledger.sample("step", step=i))
+                    # flight recorder: persist this window's spans and
+                    # stamp the heartbeat with the rank's current phase
+                    # — the `watch` per-rank "where is it" column
+                    timeline_mod.flush()
                     # input-service backpressure rides the heartbeat:
                     # ring occupancy now + consumer-wait delta this
                     # window, so a starved host is visible fleet-wide
@@ -2105,6 +2159,7 @@ def run_benchmark(
                     fleet_writer.heartbeat(
                         step=hb_step, step_ewma_ms=ewma_ms,
                         mem_peak_bytes=mem_ledger.peak_bytes or None,
+                        phase=timeline_mod.current_phase(),
                         **hb_input)
                     if world > 1:
                         skew = obs_fleet.straggler_gather(hb_step, ewma_ms)
@@ -2164,6 +2219,7 @@ def run_benchmark(
         phases.end(step=cfg.num_batches)
         obs_writer.close()
         fleet_writer.close()
+        timeline_mod.detach()
         raise guards_mod.NonFiniteError(
             f"non-finite loss at display step(s) "
             f"{nonfinite_display[:16]} (--on_nonfinite=abort; use skip "
@@ -2295,6 +2351,7 @@ def run_benchmark(
     obs_writer.event("summary", **summary_fields)
     obs_writer.close()
     fleet_writer.close()
+    timeline_mod.detach()       # flush the span tail, close spans.<k>.jsonl
     print_fn("-" * 40)
     print_fn(f"total {units}/sec: {total_rate:.2f}")
     # the p50 token names its own granularity: "/step" is a true per-step

@@ -77,6 +77,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tpu_hc_bench.obs import timeline as timeline_mod
 from tpu_hc_bench.train.step import TrainState
 
 _STEP_RE = re.compile(r"step_(\d+)")
@@ -195,15 +196,16 @@ def snapshot_to_host(state: TrainState) -> tuple[int, dict]:
         "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
     }
-    for leaf in jax.tree.leaves(trees):
-        if isinstance(leaf, jax.Array):
-            try:
-                leaf.copy_to_host_async()
-            except Exception:
-                pass    # backend without async copies: the gather pays
-    payload: dict = {"step": np.asarray(step)}
-    for name, tree in trees.items():
-        payload[name] = jax.device_get(tree)
+    with timeline_mod.span("ckpt_snapshot", step=step):
+        for leaf in jax.tree.leaves(trees):
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:
+                    pass    # backend without async copies: the gather pays
+        payload: dict = {"step": np.asarray(step)}
+        for name, tree in trees.items():
+            payload[name] = jax.device_get(tree)
     return step, payload
 
 
@@ -219,9 +221,13 @@ def write_host_payload(payload: dict, directory: str | Path,
     base.mkdir(parents=True, exist_ok=True)
     tmp = base / (_step_dir(base, step).name + ".tmp")
     stale_id = _marker_id(_marker(base, step))
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(tmp.resolve(), payload, force=True)
-    return _commit_step_dir(base, step, tmp, stale_id, topology=topology)
+    # span-recorded (obs.timeline): from the writer thread this shows as
+    # the overlapped write lane; from the main thread, the blocking one
+    with timeline_mod.span("ckpt_write", step=step):
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(tmp.resolve(), payload, force=True)
+        return _commit_step_dir(base, step, tmp, stale_id,
+                                topology=topology)
 
 
 def save(state: TrainState, directory: str | Path,
@@ -534,8 +540,9 @@ def restore(state: TrainState, directory: str | Path,
             for k in template
         }
     ckptr = ocp.PyTreeCheckpointer()
-    payload = ckptr.restore(_step_dir(base, step).resolve(), item=template,
-                            restore_args=restore_args)
+    with timeline_mod.span("ckpt_restore", step=int(step)):
+        payload = ckptr.restore(_step_dir(base, step).resolve(),
+                                item=template, restore_args=restore_args)
     return state.replace(
         step=jax.numpy.asarray(payload["step"], dtype=jax.numpy.int32),
         params=payload["params"],
@@ -598,12 +605,14 @@ def save_pp(params, opt_state, step: int, directory: str | Path,
     base.mkdir(parents=True, exist_ok=True)
     tmp = base / (_step_dir(base, int(step)).name + ".tmp")
     stale_id = _marker_id(_marker(base, int(step)))
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save((tmp / "pp_params").resolve(), params, force=True)
-    if opt_state is not None:
-        ckptr.save((tmp / "opt_state").resolve(), opt_state, force=True)
-    return _commit_step_dir(base, int(step), tmp, stale_id,
-                            topology=topology)
+    with timeline_mod.span("ckpt_write", step=int(step)):
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save((tmp / "pp_params").resolve(), params, force=True)
+        if opt_state is not None:
+            ckptr.save((tmp / "opt_state").resolve(), opt_state,
+                       force=True)
+        return _commit_step_dir(base, int(step), tmp, stale_id,
+                                topology=topology)
 
 
 def restore_pp(params, opt_state, directory: str | Path,
@@ -636,10 +645,11 @@ def restore_pp(params, opt_state, directory: str | Path,
                                            dtype=x.dtype), tree)
 
     ckptr = ocp.PyTreeCheckpointer()
-    params = ckptr.restore((path / "pp_params").resolve(), item=params,
-                           restore_args=args_of(params))
-    if opt_state is not None:
-        opt_state = ckptr.restore((path / "opt_state").resolve(),
-                                  item=opt_state,
-                                  restore_args=args_of(opt_state))
+    with timeline_mod.span("ckpt_restore", step=int(step)):
+        params = ckptr.restore((path / "pp_params").resolve(), item=params,
+                               restore_args=args_of(params))
+        if opt_state is not None:
+            opt_state = ckptr.restore((path / "opt_state").resolve(),
+                                      item=opt_state,
+                                      restore_args=args_of(opt_state))
     return params, opt_state, step
