@@ -48,8 +48,12 @@ def _serve_main() -> int:
     the same BENCH_*.json trajectory as the training one.  Shares the
     env grammar: BENCH_MODEL (a decoder/classify member),
     BENCH_ARRIVAL, BENCH_ARRIVAL_RATE, BENCH_REQUESTS, BENCH_SERVE_BUCKETS,
-    BENCH_BATCHING, BENCH_COMPILE_CACHE, BENCH_METRICS_DIR,
-    BENCH_CONFIG=auto (resolves the <model>@serve registry row).
+    BENCH_BATCHING, BENCH_DECODE_ATTENTION (gather|paged), BENCH_QUANT
+    (off|int8_w|int8_kv), BENCH_DECODE_BLOCK_PAGES, BENCH_COMPILE_CACHE,
+    BENCH_METRICS_DIR, BENCH_CONFIG=auto (resolves the <model>@serve
+    registry row).  The extras carry decode_attention/quant and the
+    worst decode bucket's AOT temp bytes so `obs regress`/`obs diff`
+    track the decode-kernel win.
     """
     from tpu_hc_bench import flags
     from tpu_hc_bench.obs import metrics as obs_metrics
@@ -64,6 +68,11 @@ def _serve_main() -> int:
         num_requests=int(os.environ.get("BENCH_REQUESTS", "48")),
         serve_buckets=os.environ.get("BENCH_SERVE_BUCKETS", "auto"),
         batching=os.environ.get("BENCH_BATCHING", "continuous"),
+        decode_attention=os.environ.get("BENCH_DECODE_ATTENTION",
+                                        "gather"),
+        quant=os.environ.get("BENCH_QUANT", "off"),
+        decode_block_pages=int(
+            os.environ.get("BENCH_DECODE_BLOCK_PAGES", "0")),
         compile_cache=os.environ.get("BENCH_COMPILE_CACHE") or None,
         metrics_dir=os.environ.get("BENCH_METRICS_DIR") or None,
     ).resolve()
@@ -93,6 +102,9 @@ def _serve_main() -> int:
             "max_in_flight": summary["max_in_flight"],
             "kv_pages": summary["kv_pages"],
             "kv_page_size": summary["kv_page_size"],
+            "decode_attention": summary.get("decode_attention"),
+            "quant": summary.get("quant"),
+            "aot_decode_temp_bytes": summary.get("aot_decode_temp_bytes"),
             "post_warmup_compiles": summary["post_warmup_compiles"],
             "config_source": cfg.config_source,
             "tuned_config": cfg.tuned_config,

@@ -1,10 +1,9 @@
-"""Serving-lane A/B benchmark: continuous vs static batching at a fixed
-arrival rate.
+"""Serving-lane A/B benchmarks: batching arms, and decode-kernel arms.
 
-The acceptance experiment of the round-16 serving subsystem
-(``tpu_hc_bench.serve``): ONE warmed engine (every (batch, seqlen)
-bucket AOT-compiled once, through ``--compile_cache`` when given), ONE
-identical seeded request trace, TWO scheduler arms —
+``--mode batching`` (default) is the round-16 acceptance experiment:
+ONE warmed engine (every (batch, seqlen) bucket AOT-compiled once,
+through ``--compile_cache`` when given), ONE identical seeded request
+trace, TWO scheduler arms —
 
 - ``static``: the classic control — collect a full batch, run it to
   completion, only then admit again; arrivals queue while stragglers
@@ -12,24 +11,40 @@ identical seeded request trace, TWO scheduler arms —
 - ``continuous``: Orca-style — admission and retirement per decode
   step; a retired request's slot is refilled at the very next step.
 
-Both arms share the warmed AOT executables, so the A/B never pays a
-second compile and ``post_warmup_compiles`` (compile-cache entry
-deltas, the round-10 hit/miss mechanism) must stay 0 for BOTH arms.
-Emits a BENCH-style JSON record: headline ``tokens_per_s`` of the
-continuous arm, ``vs_baseline`` = continuous/static tokens/s, and
-``p99_ms``/``goodput``/``tokens_per_s`` per arm in ``extra`` — plus an
-``obs diff``-renderable pair of metrics dirs under ``--metrics_root``.
+``--mode decode`` (round 18) is the decode-kernel/quantization A/B:
+one engine PER arm (the arms compile different decode programs), same
+trace, continuous batching —
+
+- ``gather/off``: the dense-gather ``_softmax_attend`` reference;
+- ``paged/off``: the Pallas flash-decode kernel reading K/V through
+  the page tables (``ops.paged_attention``);
+- ``paged/int8_kv``: + int8 KV pool with per-page scales consumed
+  inside the kernel;
+- ``paged/int8_w``: + per-channel int8 weights dequantized at the
+  matmul.
+
+The verdict checks the worst decode bucket's AOT ``memory_analysis``
+temp bytes (the dense-gather temporaries the kernel eliminates), the
+int8 pool's argument-byte shrink, ZERO post-warmup compiles on every
+arm, and token-for-token parity of the f32 arms (read back from the
+per-arm request records).
+
+Both modes emit a BENCH-style JSON record with
+``decode_attention``/``quant``/``aot_decode_temp_bytes`` in ``extra``
+(the fields ``obs regress``/``obs diff`` track) plus ``obs
+diff``-renderable per-arm metrics dirs under ``--metrics_root``.
 
 Env knobs (CI parity with bench.py):
 
 - ``BENCH_MODEL`` (default moe_tiny), ``BENCH_ARRIVAL_RATE``,
   ``BENCH_SERVE_BUCKETS``, ``BENCH_REQUESTS``, ``BENCH_MAX_IN_FLIGHT``,
+  ``BENCH_DECODE_ATTENTION``, ``BENCH_QUANT``, ``BENCH_MODE``,
   ``BENCH_COMPILE_CACHE`` (a dir makes the zero-recompile assertion
   measured, not vacuous).
 
 Usage:
   JAX_PLATFORMS=cpu python scripts/bench_serve.py \
-      [--json OUT.json] [--metrics_root DIR]
+      [--mode batching|decode] [--json OUT.json] [--metrics_root DIR]
 """
 
 from __future__ import annotations
@@ -42,12 +57,10 @@ import sys
 sys.path.insert(0, ".")
 
 
-def run_ab(args) -> dict:
+def _build_cfg(args, **overrides):
     from tpu_hc_bench import flags as flags_mod
-    from tpu_hc_bench.obs import metrics as obs_metrics
-    from tpu_hc_bench.serve import cli as serve_cli
 
-    cfg = flags_mod.BenchmarkConfig(
+    kw = dict(
         model=args.model,
         workload="serve",
         arrival=args.arrival,
@@ -58,9 +71,22 @@ def run_ab(args) -> dict:
         kv_page_size=args.kv_page_size,
         max_prompt_len=args.max_prompt_len,
         max_output_len=args.max_output_len,
+        decode_attention=args.decode_attention,
+        quant=args.quant,
+        decode_block_pages=args.decode_block_pages,
         compile_cache=args.compile_cache,
         seed=args.seed,
-    ).resolve()
+    )
+    kw.update(overrides)
+    return flags_mod.BenchmarkConfig(**kw).resolve()
+
+
+def run_ab(args) -> dict:
+    from tpu_hc_bench import flags as flags_mod
+    from tpu_hc_bench.obs import metrics as obs_metrics
+    from tpu_hc_bench.serve import cli as serve_cli
+
+    cfg = _build_cfg(args)
 
     log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
     engine, requests = serve_cli.build_engine_and_requests(cfg, log)
@@ -134,9 +160,127 @@ def run_ab(args) -> dict:
             "max_in_flight": engine.cap,
             "kv_page_size": engine.page_size,
             "kv_pages": engine.num_pages,
+            "decode_attention": cfg.decode_attention,
+            "quant": cfg.quant,
+            "aot_decode_temp_bytes": engine.compile_record.get(
+                "aot_decode_temp_bytes"),
             "p99_ms": ct["p99_e2e_ms"],
             "goodput": ct["goodput"],
             "tokens_per_s": ct["tokens_per_s"],
+            "arms": arms,
+            "verdict": verdict,
+        },
+        "manifest": manifest,
+    }
+
+
+DECODE_ARMS = (("gather", "off"), ("paged", "off"),
+               ("paged", "int8_kv"), ("paged", "int8_w"))
+
+
+def run_decode_ab(args) -> dict:
+    """The round-18 decode-kernel/quant A/B: one engine per arm (the
+    arms compile different decode programs), same seeded trace,
+    continuous batching, zero post-warmup compiles everywhere."""
+    import tempfile
+
+    from tpu_hc_bench.obs import metrics as obs_metrics
+    from tpu_hc_bench.serve import cli as serve_cli
+
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    root = args.metrics_root or tempfile.mkdtemp(prefix="bench_decode_")
+    arms: dict[str, dict] = {}
+    tokens: dict[str, dict] = {}
+    base_cfg = None
+    for da, q in DECODE_ARMS:
+        arm = f"{da}+{q}"
+        cfg = _build_cfg(args, decode_attention=da, quant=q,
+                         decode_block_pages=(args.decode_block_pages
+                                             if da == "paged" else 0))
+        base_cfg = base_cfg or cfg
+        log(f"--- decode arm: {arm} ---")
+        engine, requests = serve_cli.build_engine_and_requests(cfg, log)
+        mdir = os.path.join(root, arm.replace("+", "_"))
+        summary = serve_cli.run_serve(
+            engine, requests, serve_cli.serve_writer(cfg, mdir),
+            batching="continuous")
+        toks = {}
+        with open(os.path.join(mdir, "metrics.jsonl")) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("kind") == "request":
+                    toks[rec["id"]] = rec.get("generated")
+        tokens[arm] = toks
+        arms[arm] = {
+            "decode_attention": da,
+            "quant": q,
+            "tokens_per_s": summary["tokens_per_s"],
+            "p99_e2e_ms": summary["p99_e2e_ms"],
+            "p99_ttft_ms": summary["p99_ttft_ms"],
+            "goodput": summary["goodput"],
+            "completed": summary["completed"],
+            "aot_decode_temp_bytes": summary["aot_decode_temp_bytes"],
+            "post_warmup_compiles": summary["post_warmup_compiles"],
+            "metrics_dir": mdir,
+        }
+        wk, wma = engine.aot_memory_worst(kinds=("decode",))
+        if wma:
+            arms[arm]["aot_decode_args_bytes"] = wma.get("argument_bytes")
+
+    ga, pa = arms["gather+off"], arms["paged+off"]
+    kv = arms["paged+int8_kv"]
+    tmp_g, tmp_p = ga["aot_decode_temp_bytes"], pa["aot_decode_temp_bytes"]
+    int8_match = sum(
+        1 for rid, t in tokens["gather+off"].items()
+        if tokens["paged+int8_kv"].get(rid) == t)
+    verdict = {
+        # the kernel eliminates the dense-gather temporaries: worst
+        # decode bucket's AOT temp bytes must drop vs the reference
+        "paged_temp_lt_gather": (
+            tmp_g is not None and tmp_p is not None and tmp_p < tmp_g),
+        "temp_bytes_delta_pct": (
+            round(100.0 * (tmp_p - tmp_g) / max(tmp_g, 1), 1)
+            if tmp_g and tmp_p is not None else None),
+        # the int8 pool quarters the KV argument bytes
+        "int8_kv_args_lt_gather": (
+            kv.get("aot_decode_args_bytes") or 0)
+            < (ga.get("aot_decode_args_bytes") or 0),
+        # pinned parity: f32 paged decode is token-for-token identical
+        # to the gather reference; int8 arms are tolerance arms, their
+        # match count is reported, not asserted
+        "paged_token_parity": tokens["gather+off"] == tokens["paged+off"],
+        "int8_kv_token_matches": f"{int8_match}/"
+                                 f"{len(tokens['gather+off'])}",
+        "zero_post_warmup_compiles": all(
+            a["post_warmup_compiles"] == 0 for a in arms.values()),
+        "all_completed": all(a["completed"] == args.num_requests
+                             for a in arms.values()),
+    }
+    manifest = obs_metrics.manifest_subset(
+        obs_metrics.run_manifest(cfg=base_cfg))
+    return {
+        "metric": f"{args.model}_decode_kernel_ab",
+        "value": pa["tokens_per_s"],
+        "unit": "tokens/sec",
+        # the paged kernel over the dense-gather reference at the same
+        # load — the decode-kernel analog of the batching A/B ratio
+        "vs_baseline": round(
+            pa["tokens_per_s"] / max(ga["tokens_per_s"], 1e-9), 3),
+        "extra": {
+            "workload": "serve",
+            "mode": "decode",
+            "model": args.model,
+            "arrival_rate": args.arrival_rate,
+            "num_requests": args.num_requests,
+            "max_prompt_len": args.max_prompt_len,
+            "max_output_len": args.max_output_len,
+            "kv_page_size": args.kv_page_size,
+            "decode_attention": "paged",
+            "quant": "off",
+            "aot_decode_temp_bytes": tmp_p,
+            "p99_ms": pa["p99_e2e_ms"],
+            "goodput": pa["goodput"],
+            "tokens_per_s": pa["tokens_per_s"],
             "arms": arms,
             "verdict": verdict,
         },
@@ -160,6 +304,20 @@ def main() -> int:
     ap.add_argument("--kv_page_size", type=int, default=16)
     ap.add_argument("--max_prompt_len", type=int, default=32)
     ap.add_argument("--max_output_len", type=int, default=16)
+    ap.add_argument("--mode", choices=["batching", "decode"],
+                    default=env("BENCH_MODE", "batching"),
+                    help="batching: continuous-vs-static on one warmed "
+                         "engine; decode: gather-vs-paged-vs-int8 "
+                         "kernel arms, one engine each")
+    ap.add_argument("--decode_attention",
+                    choices=["gather", "paged"],
+                    default=env("BENCH_DECODE_ATTENTION", "gather"),
+                    help="batching mode: the decode program both "
+                         "scheduler arms run on")
+    ap.add_argument("--quant", choices=["off", "int8_w", "int8_kv"],
+                    default=env("BENCH_QUANT", "off"))
+    ap.add_argument("--decode_block_pages", type=int,
+                    default=int(env("BENCH_DECODE_BLOCK_PAGES", "0")))
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--compile_cache",
                     default=env("BENCH_COMPILE_CACHE") or None,
@@ -174,16 +332,21 @@ def main() -> int:
                     help="also write the comparison JSON here")
     args = ap.parse_args()
 
-    result = run_ab(args)
+    result = run_decode_ab(args) if args.mode == "decode" \
+        else run_ab(args)
     print(json.dumps(result, indent=1))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(result, f, indent=1)
         print(f"wrote {args.json}", file=sys.stderr)
     v = result["extra"]["verdict"]
-    ok = (v["continuous_beats_static_p99"]
-          and v["continuous_beats_static_goodput"]
-          and v["zero_post_warmup_compiles"])
+    if args.mode == "decode":
+        ok = (v["paged_temp_lt_gather"] and v["paged_token_parity"]
+              and v["zero_post_warmup_compiles"] and v["all_completed"])
+    else:
+        ok = (v["continuous_beats_static_p99"]
+              and v["continuous_beats_static_goodput"]
+              and v["zero_post_warmup_compiles"])
     return 0 if ok else 1
 
 

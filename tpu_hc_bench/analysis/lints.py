@@ -74,6 +74,7 @@ from __future__ import annotations
 import ast
 import functools
 import os
+import re
 import symtable
 from pathlib import Path
 
@@ -95,8 +96,10 @@ TUNED_STALENESS = "tuned-config-staleness"
 HOT_MEMORY = "memory-probe-in-hot-loop"
 SERVE_RECOMPILE = "serve-bucket-recompile"
 SPAN_IN_JIT = "span-in-compiled-fn"
+DEQUANT_HOT = "dequantize-in-hot-loop"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
-                    INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE, SPAN_IN_JIT)
+                    INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE, SPAN_IN_JIT,
+                    DEQUANT_HOT)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -658,6 +661,89 @@ class _FileLinter:
                 return True
         return False
 
+    # -- pass: dequantize in a hot loop --------------------------------
+
+    # identifiers that mark a value as a quantized/cached int8 buffer
+    # (lexical, like the memory-probe pass — a quantized buffer hidden
+    # behind an innocent name is on the reviewer).  A bare `q` is NOT
+    # quantish: it is the attention convention for the query
+    _QUANTISH = re.compile(r"int8|quant|_q8?($|_)|(^|_)q8($|_)")
+    _LOOP_TRACERS = {"scan", "fori_loop", "while_loop"}
+
+    @functools.cached_property
+    def _loop_traced_funcs(self) -> set[ast.AST]:
+        """FunctionDefs passed (by name, incl. through partial) to
+        ``lax.scan``/``fori_loop``/``while_loop`` — their bodies run
+        once per iteration, same as a Python loop body."""
+        names: set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_basename(node) not in self._LOOP_TRACERS:
+                continue
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    names.add(a.id)
+                elif isinstance(a, ast.Call) \
+                        and _callee_basename(a) == "partial":
+                    for pa in a.args:
+                        if isinstance(pa, ast.Name):
+                            names.add(pa.id)
+        return {n for n in ast.walk(self.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name in names}
+
+    def _check_dequant_hot_loop(self):
+        """**dequantize-in-hot-loop** (error): ``X.astype(...)`` of a
+        quantized/cached int8 buffer used as a bare operand of an
+        elementwise ``*`` inside a scan/loop body.  That shape is the
+        dense-dequant anti-pattern: a full-width f32 copy of the
+        cached buffer materializes on every iteration of the hot loop
+        (every decode layer / scan step).  The accepted forms keep the
+        dequantize *scale-fused*: the int8 operand feeds the matmul
+        and the per-channel scale multiplies the matmul OUTPUT
+        (``einsum(spec, x, q.astype(dt)) * scale`` —
+        ``serve.decode._qeinsum``), or the astype lives inside a
+        Pallas kernel next to its matmul (``ops.paged_attention``).
+        Detection is lexical (the buffer's identifiers must spell
+        int8/quant/_q, like the memory-probe pass); loop headers and
+        nested defs are exempt through the same loop-body walk.
+        """
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"):
+                continue
+            parent = self._parents.get(node)
+            if not (isinstance(parent, ast.BinOp)
+                    and isinstance(parent.op, ast.Mult)):
+                continue
+            idents = set()
+            for n in ast.walk(node.func.value):
+                if isinstance(n, ast.Name):
+                    idents.add(n.id)
+                elif isinstance(n, ast.Attribute):
+                    idents.add(n.attr)
+            if not any(self._QUANTISH.search(i) for i in idents):
+                continue
+            in_loop = self._enclosing_loop_body(node) is not None
+            if not in_loop:
+                in_loop = any(f in self._loop_traced_funcs
+                              for f in self._enclosing_functions(node))
+            if not in_loop:
+                continue
+            src = _dotted(node.func.value) or "<expr>"
+            self._emit(
+                DEQUANT_HOT, "error", node,
+                f"`{src}.astype(...) * scale` dequantizes a cached "
+                "int8 buffer elementwise inside a scan/loop body — a "
+                "full-width f32 copy materializes every iteration; "
+                "use the scale-fused matmul form instead (int8 feeds "
+                "the einsum/dot, the per-channel scale multiplies the "
+                "matmul OUTPUT — serve.decode._qeinsum), or dequantize "
+                "inside the kernel next to its matmul "
+                "(ops.paged_attention)")
+
     # -- pass: flight-recorder calls inside traced code ----------------
 
     # obs.timeline's recorder surface: host-clock reads + ring stores —
@@ -778,6 +864,7 @@ class _FileLinter:
         self._check_checkpoint_topology()
         self._check_input_pool()
         self._check_memory_probe_hot_loop()
+        self._check_dequant_hot_loop()
         self._check_serve_recompile()
         return self.findings
 

@@ -92,7 +92,8 @@ TRAIN_ONLY_FLAGS = (
 SERVE_ONLY_FLAGS = (
     "arrival", "arrival_rate", "num_requests", "serve_buckets",
     "max_in_flight", "kv_page_size", "kv_pages", "max_prompt_len",
-    "max_output_len", "batching",
+    "max_output_len", "batching", "decode_attention", "quant",
+    "decode_block_pages",
 )
 
 
@@ -560,6 +561,27 @@ class BenchmarkConfig:
                                               # batch, run it to completion,
                                               # only then admit again (the
                                               # A/B control arm)
+    decode_attention: str = "gather"          # decode attention program
+                                              # (round 18): gather = dense
+                                              # page gather + softmax (the
+                                              # parity reference) | paged =
+                                              # Pallas flash-decode kernel
+                                              # reading K/V directly
+                                              # through the page tables
+                                              # (ops.paged_attention)
+    quant: str = "off"                        # serving quantization arm:
+                                              # off | int8_w (per-channel
+                                              # int8 weights, dequantized
+                                              # AT the matmul) | int8_kv
+                                              # (int8 KV pool + per-page
+                                              # scales consumed inside the
+                                              # paged kernel; requires
+                                              # --decode_attention=paged)
+    decode_block_pages: int = 0               # paged kernel block size:
+                                              # KV pages streamed per grid
+                                              # step (0 = auto: 1 page, the
+                                              # page IS the block; tuned
+                                              # like any other lever)
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -641,6 +663,28 @@ class BenchmarkConfig:
         if self.batching not in ("continuous", "static"):
             raise ValueError(
                 f"--batching must be continuous|static: {self.batching!r}")
+        if self.decode_attention not in ("gather", "paged"):
+            raise ValueError(
+                f"--decode_attention must be gather|paged: "
+                f"{self.decode_attention!r}")
+        if self.quant not in ("off", "int8_w", "int8_kv"):
+            raise ValueError(
+                f"--quant must be off|int8_w|int8_kv: {self.quant!r}")
+        if self.quant == "int8_kv" and self.decode_attention != "paged":
+            raise ValueError(
+                "--quant=int8_kv stores per-page scales that are "
+                "consumed INSIDE the paged decode kernel; set "
+                "--decode_attention=paged (the gather reference has no "
+                "scale-fused read path)")
+        if self.decode_block_pages < 0:
+            raise ValueError(
+                f"--decode_block_pages must be >= 0 (0 = auto): "
+                f"{self.decode_block_pages}")
+        if self.decode_block_pages and self.decode_attention != "paged":
+            raise ValueError(
+                "--decode_block_pages sizes the paged kernel's page "
+                "blocks; it has no meaning under "
+                "--decode_attention=gather")
         # loud format checks (raise on malformed spec; values re-read by
         # the engine)
         parse_serve_buckets(self.serve_buckets, self.max_in_flight)
@@ -1071,6 +1115,10 @@ class BenchmarkConfig:
                 f"buckets={buckets} max_in_flight={self.max_in_flight} "
                 f"kv_page_size={self.kv_page_size} "
                 f"kv_pages={self.kv_pages or 'auto'}",
+                f"decode_attention={self.decode_attention} "
+                f"quant={self.quant}"
+                + (f" decode_block_pages={self.decode_block_pages}"
+                   if self.decode_block_pages else ""),
             ]
             for k, v in self.translations.items():
                 lines.append(f"translated: {k}: {v}")
@@ -1241,6 +1289,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_output_len", type=int, default=d.max_output_len)
     p.add_argument("--batching", type=str, default=d.batching,
                    choices=["continuous", "static"])
+    p.add_argument("--decode_attention", type=str,
+                   default=d.decode_attention,
+                   choices=["gather", "paged"])
+    p.add_argument("--quant", type=str, default=d.quant,
+                   choices=["off", "int8_w", "int8_kv"])
+    p.add_argument("--decode_block_pages", type=int,
+                   default=d.decode_block_pages)
     return p
 
 

@@ -44,6 +44,10 @@ CHECKS = (
     (("extra", "p99_ms"), "lower", "p99 e2e ms"),
     (("extra", "p99_ttft_ms"), "lower", "p99 ttft ms"),
     (("extra", "peak_hbm_bytes"), "lower", "peak HBM bytes"),
+    # round 18: the decode-kernel win — a rise in the worst decode
+    # bucket's AOT temp bytes means the paged arm regressed toward the
+    # dense-gather temporaries it exists to eliminate
+    (("extra", "aot_decode_temp_bytes"), "lower", "aot decode temp B"),
 )
 
 #: identity fields folded into the fingerprint (record path order)
@@ -52,8 +56,19 @@ FINGERPRINT_KEYS = (
     ("extra", "global_batch"), ("extra", "chips"), ("extra", "dtype"),
     ("extra", "variable_update"), ("extra", "batching"),
     ("extra", "arrival_rate"),
+    # round 18: the kernel/quant arms are config identity, not noise —
+    # a gather-vs-paged pair must never share a history fingerprint
+    ("extra", "decode_attention"), ("extra", "quant"),
     ("manifest", "device_kind"), ("manifest", "process_count"),
 )
+
+# absent fingerprint keys normalize to the value older records
+# effectively ran with, so pre-round-18 serve history keeps comparing
+# against fresh default-arm runs instead of being silently orphaned
+_FINGERPRINT_DEFAULTS = {
+    ("extra", "decode_attention"): "gather",
+    ("extra", "quant"): "off",
+}
 
 DEFAULT_MAD_K = 4.0
 DEFAULT_REL_FLOOR = 0.03
@@ -100,7 +115,10 @@ def load_bench_record(path: str) -> dict | None:
 
 
 def fingerprint(rec: dict) -> tuple:
-    return tuple(_get(rec, path) for path in FINGERPRINT_KEYS)
+    return tuple(
+        _FINGERPRINT_DEFAULTS.get(path) if _get(rec, path) is None
+        else _get(rec, path)
+        for path in FINGERPRINT_KEYS)
 
 
 def load_history(specs: list[str],

@@ -14,9 +14,19 @@ shows a win.  The record (BASELINE.md):
   select-and-scatter on googlenet's pool shapes (the 9-tap VPU loop
   loses to the hardware window scan); kept as parity-tested apparatus,
   not wired into any model.
+- ``paged_decode_attention`` — round 18: the serving lane's flash-decode
+  kernel, K/V read directly through the int32 page tables (scalar
+  prefetch + table-resolved block index maps, online softmax over
+  pages, optional int8 pool with in-kernel per-page dequant).  Wired
+  as ``--decode_attention=paged`` (serve lane).
+- ``fused_residual_norm`` — round 18: fused residual-add + Layer/RMS
+  norm used by both paged decode families (one VMEM round-trip where
+  the unfused form pays three HBM trips per layer).
 """
 
 from tpu_hc_bench.ops.flash_attention import flash_attention  # noqa: F401
 from tpu_hc_bench.ops.fused_conv import fused_bn_relu_conv  # noqa: F401
+from tpu_hc_bench.ops.fused_residual_ln import fused_residual_norm  # noqa: F401
+from tpu_hc_bench.ops.paged_attention import paged_decode_attention  # noqa: F401
 from tpu_hc_bench.ops.pool_bwd import max_pool as pallas_max_pool  # noqa: F401
 from tpu_hc_bench.ops.xent import softmax_xent, softmax_xent_reference  # noqa: F401

@@ -1,0 +1,26 @@
+"""Shared plumbing for the Pallas kernel modules.
+
+Every kernel in ``tpu_hc_bench.ops`` runs as a real Mosaic program on
+TPU and in Pallas *interpreter* mode everywhere else — that is how the
+unit tests exercise the kernels bit-for-bit on the virtual CPU mesh.
+Before round 18 each module carried its own copy of the backend probe;
+this is the one shared copy (plus the tiny shape helpers that were
+growing copies of their own).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["interpret", "pad_up"]
+
+
+def interpret() -> bool:
+    """True when the Pallas kernels must run in interpreter mode (any
+    non-TPU backend — the CPU test mesh, debugging on GPU hosts)."""
+    return jax.default_backend() != "tpu"
+
+
+def pad_up(x: int, m: int) -> int:
+    """``x`` rounded up to the next multiple of ``m``."""
+    return (x + m - 1) // m * m

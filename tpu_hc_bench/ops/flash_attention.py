@@ -31,6 +31,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_hc_bench.ops._pallas import interpret as _interpret
+from tpu_hc_bench.ops._pallas import pad_up as _pad_up
+
 # Default blocks: 1024x1024, confirmed by a round-2 back-to-back A/B
 # inside the FULL gpt2 train step (162.0 ms vs 175.8 ms for 512x512 at
 # seq 1024 bs 16 — +8.5%).  NOTE the *isolated-kernel* microbench says
@@ -45,19 +48,11 @@ _BLOCK_K = 1024
 _NEG_INF = -1e30
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
 # batch*heads and the outer block dim are embarrassingly parallel; only the
 # innermost (accumulating) grid dim carries loop state
 _PARAMS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary")
 )
-
-
-def _pad_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
 
 
 def _mask(i, j, bq, bk, seq_k, causal):

@@ -48,9 +48,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from tpu_hc_bench.ops._pallas import interpret as _interpret
 
 
 def _pick_group(batch: int, rows: int, target: int = 784) -> int:

@@ -49,6 +49,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_hc_bench.ops._pallas import interpret as _interpret
+
 # Mosaic's stack accounting for this kernel measures ~12.4 bytes per
 # input element per window tap (89.55M for 112x112x64 at 9 taps); the
 # scoped limit is raised to 100M of v5e's 128M physical VMEM and tiles
@@ -56,10 +58,6 @@ from jax.experimental.pallas import tpu as pltpu
 VMEM_LIMIT_BYTES = 100 * 1024 * 1024
 _STACK_BYTES_PER_ELEM_TAP = 12.4
 _BUDGET = VMEM_LIMIT_BYTES * 0.9
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _same_pad_low(in_dim: int, window: int, stride: int) -> tuple[int, int]:

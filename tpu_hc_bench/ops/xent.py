@@ -41,15 +41,13 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from tpu_hc_bench.ops._pallas import interpret as _interpret
+
 # Row/vocab block sizes: rows feed the VPU 8-sublane tiles, vocab blocks
 # are lane-major multiples of 128.  512*128 f32 block = 256 KiB in VMEM.
 _BLOCK_ROWS = 128
 _BLOCK_VOCAB = 512
 _NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 # ---------------------------------------------------------------------------

@@ -11,15 +11,43 @@ only the attention inner product — the part that must read a KV cache
 — is reimplemented, with the same f32-softmax/1-over-sqrt(d)
 convention as ``parallel.sequence.dense_attention``.  Numerical parity
 with ``model.apply`` over the full context is pinned by
-``tests/test_serve.py``.
+``tests/test_serve.py`` and ``tests/test_zz_decode_kernels.py``.
 
 **Paged KV cache** (vLLM): one pool of fixed-size pages per run,
 ``k_pages``/``v_pages`` shaped ``[layers, pages, page_size, kv_heads,
 head_dim]``.  A request holds a page *table* (int32 page indices); the
-decode step gathers its keys by table lookup and scatters the new
+decode step reads its keys through the table and scatters the new
 token's K/V into ``table[pos // page]``.  Page 0 is the reserved
 *trash* page: padded/inactive rows write there (and are masked on
 read), so one compiled program serves any admission pattern.
+
+**Decode attention arms** (round 18, ``--decode_attention``):
+
+- ``gather`` — the reference: gather the tables' pages into a dense
+  worst-case ``[b, S, heads, d]`` temporary and run ``_softmax_attend``.
+  Simple, and the parity anchor for everything else.
+- ``paged`` — ``ops.paged_decode_attention``: a Pallas flash-decode
+  kernel that reads K/V *directly through the page tables* (no dense
+  gather ever materializes; online softmax over pages; block size =
+  ``--decode_block_pages``).  The fresh token's K/V — not yet in the
+  pool — merge into the online softmax through the kernel's returned
+  logsumexp, so the scatter stays the one vectorized write at the end
+  of the step.  The paged arm also fuses each residual-add with the
+  following norm (``ops.fused_residual_norm``).
+
+**Quantization arms** (``--quant``):
+
+- ``int8_w`` — ``quantize_weights``: the decode projections (QKV,
+  attention out, dense FFN / SwiGLU) held as per-output-channel int8
+  with f32 scales, dequantized *at the matmul* (the scale multiplies
+  the matmul output — never a dense f32 weight copy in the layer
+  loop; the ``dequantize-in-hot-loop`` lint enforces the form).  MoE
+  expert tensors stay f32 (the ragged dispatch owns them).
+- ``int8_kv`` — the page pool is int8 with one f32 scale per (layer,
+  page), written at prefill (per-chunk amax) and on every append (the
+  touched page is dequantized, extended, and requantized — one
+  vectorized op over all layers, outside the layer loop), and
+  consumed *inside* the paged kernel.  Requires the paged arm.
 
 Two compiled shapes per family, both AOT-lowered at engine warmup
 (``obs.efficiency.aot_compile``):
@@ -44,7 +72,15 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tpu_hc_bench.ops._pallas import pad_up as _pad_up
+from tpu_hc_bench.ops.fused_residual_ln import fused_residual_norm
+from tpu_hc_bench.ops.paged_attention import paged_decode_attention
+
 _NEG_INF = -1e30
+_QUANT_EPS = 1e-8
+
+QUANT_ARMS = ("off", "int8_w", "int8_kv")
+DECODE_ATTENTION_ARMS = ("gather", "paged")
 
 
 def _softmax_attend(q, keys, values, mask):
@@ -63,6 +99,36 @@ def _softmax_attend(q, keys, values, mask):
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(values.dtype), values)
 
 
+def _qeinsum(spec, x, leaf, dtype):
+    """Scale-fused quantized matmul: the int8 kernel feeds the einsum
+    directly and the per-output-channel scale multiplies the matmul
+    OUTPUT — the form that never materializes a dense f32 weight copy
+    (and the form the ``dequantize-in-hot-loop`` lint accepts)."""
+    return (jnp.einsum(spec, x, leaf["q"].astype(dtype))
+            * leaf["scale"].astype(dtype))
+
+
+def _quantize_leaf(w, contract_axes) -> dict:
+    """Per-output-channel symmetric int8: amax over the contraction
+    axes, scale = amax/127 (floored so all-zero channels stay finite)."""
+    wf = jnp.asarray(w).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=contract_axes, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, _QUANT_EPS)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": jnp.squeeze(scale, axis=contract_axes)}
+
+
+def _with_path(tree: dict, path: tuple, value) -> dict:
+    """A copy of ``tree`` with the node at ``path`` replaced (shallow
+    copies along the path only; untouched subtrees are shared)."""
+    d = dict(tree)
+    if len(path) == 1:
+        d[path[0]] = value
+    else:
+        d[path[0]] = _with_path(tree[path[0]], path[1:], value)
+    return d
+
+
 @dataclasses.dataclass
 class _Family:
     """One decoder family's functional pieces over its own param tree."""
@@ -72,15 +138,20 @@ class _Family:
     heads: int
     kv_heads: int
     head_dim: int
+    norm_kind: str              # "layernorm" (GPT) | "rmsnorm" (Llama)
     embed_decode: Callable      # (params, tokens [b], positions [b]) -> [b,1,H]
     layer_params: Callable      # (params, l) -> layer subtree
     attn_norm: Callable         # (p_l, x) -> normed
+    attn_norm_params: Callable  # (p_l) -> (gamma, beta|None)
     qkv: Callable               # (p_l, x, positions [b,s]) -> q, k, v
                                 # ([b,s,heads,d], [b,s,kvh,d] x2; RoPE
                                 # families rotate inside)
     attn_out: Callable          # (p_l, ctx [b,s,heads,d]) -> [b,s,H]
     ffn: Callable               # (p_l, x normed) -> [b,s,H]
     ffn_norm: Callable          # (p_l, x) -> normed
+    ffn_norm_params: Callable   # (p_l) -> (gamma, beta|None)
+    quant_paths: Callable       # (l) -> [(param path, contract axes)]
+                                # quantize_weights' int8_w walk
 
     def embed_prefill(self, params, tokens):
         # positions arange(s) — exactly the training forward's layout
@@ -91,10 +162,20 @@ class _Family:
         return self.model.pp_head(params, x)
 
 
-def build_family(model) -> _Family:
-    """The family adapter for a constructed decoder module."""
+def build_family(model, quant: str = "off") -> _Family:
+    """The family adapter for a constructed decoder module.
+
+    ``quant="int8_w"`` swaps the projection callables for scale-fused
+    int8 einsums over the tree ``quantize_weights`` produces; every
+    other leaf (embeddings, norms, biases, head, MoE experts) is read
+    exactly as in the f32 adapter.
+    """
     from tpu_hc_bench.models.gpt import GPTLM
     from tpu_hc_bench.models.llama import LlamaLM, RMSNorm, apply_rope
+
+    if quant not in QUANT_ARMS:
+        raise ValueError(f"quant must be one of {QUANT_ARMS}: {quant!r}")
+    int8_w = quant == "int8_w"
 
     if isinstance(model, GPTLM):
         if model.scan_layers:
@@ -109,11 +190,30 @@ def build_family(model) -> _Family:
             wpe = params["wpe"]["embedding"].astype(dt)
             return (wte[tokens] + wpe[positions])[:, None]
 
-        def qkv(p_l, x, positions):
-            del positions               # learned positions live in embed
-            qkv_all = nn.DenseGeneral((3, model.heads, d), dtype=dt).apply(
-                {"params": p_l["MultiHeadAttention_0"]["qkv"]}, x)
-            return qkv_all[:, :, 0], qkv_all[:, :, 1], qkv_all[:, :, 2]
+        if int8_w:
+            def qkv(p_l, x, positions):
+                del positions           # learned positions live in embed
+                a = p_l["MultiHeadAttention_0"]["qkv"]
+                out = (_qeinsum("bsh,hknd->bsknd", x, a["kernel"], dt)
+                       + a["bias"].astype(dt))
+                return out[:, :, 0], out[:, :, 1], out[:, :, 2]
+
+            def attn_out(p_l, ctx):
+                o = p_l["MultiHeadAttention_0"]["out"]
+                return (_qeinsum("bsnd,ndh->bsh", ctx, o["kernel"], dt)
+                        + o["bias"].astype(dt))
+        else:
+            def qkv(p_l, x, positions):
+                del positions           # learned positions live in embed
+                qkv_all = nn.DenseGeneral((3, model.heads, d),
+                                          dtype=dt).apply(
+                    {"params": p_l["MultiHeadAttention_0"]["qkv"]}, x)
+                return qkv_all[:, :, 0], qkv_all[:, :, 1], qkv_all[:, :, 2]
+
+            def attn_out(p_l, ctx):
+                return nn.DenseGeneral(
+                    model.hidden, axis=(-2, -1), dtype=dt).apply(
+                    {"params": p_l["MultiHeadAttention_0"]["out"]}, ctx)
 
         def ffn(p_l, h):
             if model.num_experts:
@@ -126,32 +226,53 @@ def build_family(model) -> _Family:
                 # silently losing its FFN), and it would also make
                 # incremental decode diverge from the full forward.
                 # Zero drops == ideal top-k == prefill/decode agree
-                # exactly; param tree is impl-independent.
+                # exactly; param tree is impl-independent.  Expert
+                # tensors stay f32 under int8_w (the ragged grouped
+                # matmuls own their layout).
                 return MoEFFN(
                     model.hidden, model.ffn, model.num_experts,
                     top_k=model.top_k, dtype=dt, impl="ragged",
                     ragged_f_chunk=model.moe_f_chunk,
                 ).apply({"params": p_l["moe"]}, h)
+            if int8_w:
+                h = (_qeinsum("bsh,hf->bsf", h, p_l["fc"]["kernel"], dt)
+                     + p_l["fc"]["bias"].astype(dt))
+                h = nn.gelu(h)
+                return (_qeinsum("bsf,fh->bsh", h, p_l["proj"]["kernel"],
+                                 dt)
+                        + p_l["proj"]["bias"].astype(dt))
             h = nn.Dense(model.ffn, dtype=dt).apply(
                 {"params": p_l["fc"]}, h)
             h = nn.gelu(h)
             return nn.Dense(model.hidden, dtype=dt).apply(
                 {"params": p_l["proj"]}, h)
 
+        def quant_paths(l):
+            base = (f"layer_{l}", "MultiHeadAttention_0")
+            paths = [(base + ("qkv", "kernel"), (0,)),
+                     (base + ("out", "kernel"), (0, 1))]
+            if not model.num_experts:
+                paths += [((f"layer_{l}", "fc", "kernel"), (0,)),
+                          ((f"layer_{l}", "proj", "kernel"), (0,))]
+            return paths
+
         return _Family(
             model=model, num_layers=model.num_layers, heads=model.heads,
-            kv_heads=model.heads, head_dim=d,
+            kv_heads=model.heads, head_dim=d, norm_kind="layernorm",
             embed_decode=embed_decode,
             layer_params=lambda params, l: params[f"layer_{l}"],
             attn_norm=lambda p_l, x: nn.LayerNorm(dtype=dt).apply(
                 {"params": p_l["ln1"]}, x),
+            attn_norm_params=lambda p_l: (p_l["ln1"]["scale"],
+                                          p_l["ln1"]["bias"]),
             qkv=qkv,
-            attn_out=lambda p_l, ctx: nn.DenseGeneral(
-                model.hidden, axis=(-2, -1), dtype=dt).apply(
-                {"params": p_l["MultiHeadAttention_0"]["out"]}, ctx),
+            attn_out=attn_out,
             ffn=ffn,
             ffn_norm=lambda p_l, x: nn.LayerNorm(dtype=dt).apply(
                 {"params": p_l["ln2"]}, x),
+            ffn_norm_params=lambda p_l: (p_l["ln2"]["scale"],
+                                         p_l["ln2"]["bias"]),
+            quant_paths=quant_paths,
         )
 
     if isinstance(model, LlamaLM):
@@ -167,44 +288,98 @@ def build_family(model) -> _Family:
             emb = params["tok_embed"]["embedding"].astype(dt)
             return emb[tokens][:, None]
 
-        def qkv(p_l, x, positions):
-            a = p_l["attn"]
-            q = nn.DenseGeneral((model.heads, d), use_bias=False,
-                                dtype=dt).apply({"params": a["wq"]}, x)
-            k = nn.DenseGeneral((model.num_kv_heads, d), use_bias=False,
-                                dtype=dt).apply({"params": a["wk"]}, x)
-            v = nn.DenseGeneral((model.num_kv_heads, d), use_bias=False,
-                                dtype=dt).apply({"params": a["wv"]}, x)
-            return (apply_rope(q, positions), apply_rope(k, positions), v)
+        if int8_w:
+            def qkv(p_l, x, positions):
+                a = p_l["attn"]
+                q = _qeinsum("bsh,hnd->bsnd", x, a["wq"]["kernel"], dt)
+                k = _qeinsum("bsh,hnd->bsnd", x, a["wk"]["kernel"], dt)
+                v = _qeinsum("bsh,hnd->bsnd", x, a["wv"]["kernel"], dt)
+                return (apply_rope(q, positions),
+                        apply_rope(k, positions), v)
 
-        def ffn(p_l, h):
-            gate = nn.Dense(model.ffn, use_bias=False, dtype=dt).apply(
-                {"params": p_l["gate"]}, h)
-            up = nn.Dense(model.ffn, use_bias=False, dtype=dt).apply(
-                {"params": p_l["up"]}, h)
-            return nn.Dense(model.hidden, use_bias=False, dtype=dt).apply(
-                {"params": p_l["down"]}, nn.silu(gate) * up)
+            def attn_out(p_l, ctx):
+                return _qeinsum("bsnd,ndh->bsh", ctx,
+                                p_l["attn"]["wo"]["kernel"], dt)
+
+            def ffn(p_l, h):
+                gate = _qeinsum("bsh,hf->bsf", h, p_l["gate"]["kernel"],
+                                dt)
+                up = _qeinsum("bsh,hf->bsf", h, p_l["up"]["kernel"], dt)
+                return _qeinsum("bsf,fh->bsh", nn.silu(gate) * up,
+                                p_l["down"]["kernel"], dt)
+        else:
+            def qkv(p_l, x, positions):
+                a = p_l["attn"]
+                q = nn.DenseGeneral((model.heads, d), use_bias=False,
+                                    dtype=dt).apply({"params": a["wq"]}, x)
+                k = nn.DenseGeneral((model.num_kv_heads, d),
+                                    use_bias=False,
+                                    dtype=dt).apply({"params": a["wk"]}, x)
+                v = nn.DenseGeneral((model.num_kv_heads, d),
+                                    use_bias=False,
+                                    dtype=dt).apply({"params": a["wv"]}, x)
+                return (apply_rope(q, positions),
+                        apply_rope(k, positions), v)
+
+            def attn_out(p_l, ctx):
+                return nn.DenseGeneral(
+                    model.hidden, axis=(-2, -1), use_bias=False,
+                    dtype=dt).apply({"params": p_l["attn"]["wo"]}, ctx)
+
+            def ffn(p_l, h):
+                gate = nn.Dense(model.ffn, use_bias=False,
+                                dtype=dt).apply({"params": p_l["gate"]}, h)
+                up = nn.Dense(model.ffn, use_bias=False, dtype=dt).apply(
+                    {"params": p_l["up"]}, h)
+                return nn.Dense(model.hidden, use_bias=False,
+                                dtype=dt).apply(
+                    {"params": p_l["down"]}, nn.silu(gate) * up)
+
+        def quant_paths(l):
+            return [((f"layer_{l}", "attn", "wq", "kernel"), (0,)),
+                    ((f"layer_{l}", "attn", "wk", "kernel"), (0,)),
+                    ((f"layer_{l}", "attn", "wv", "kernel"), (0,)),
+                    ((f"layer_{l}", "attn", "wo", "kernel"), (0, 1)),
+                    ((f"layer_{l}", "gate", "kernel"), (0,)),
+                    ((f"layer_{l}", "up", "kernel"), (0,)),
+                    ((f"layer_{l}", "down", "kernel"), (0,))]
 
         return _Family(
             model=model, num_layers=model.num_layers, heads=model.heads,
-            kv_heads=model.num_kv_heads, head_dim=d,
+            kv_heads=model.num_kv_heads, head_dim=d, norm_kind="rmsnorm",
             embed_decode=embed_decode,
             layer_params=lambda params, l: params[f"layer_{l}"],
             attn_norm=lambda p_l, x: RMSNorm(dtype=dt).apply(
                 {"params": p_l["attn_norm"]}, x),
+            attn_norm_params=lambda p_l: (p_l["attn_norm"]["scale"], None),
             qkv=qkv,
-            attn_out=lambda p_l, ctx: nn.DenseGeneral(
-                model.hidden, axis=(-2, -1), use_bias=False,
-                dtype=dt).apply({"params": p_l["attn"]["wo"]}, ctx),
+            attn_out=attn_out,
             ffn=ffn,
             ffn_norm=lambda p_l, x: RMSNorm(dtype=dt).apply(
                 {"params": p_l["mlp_norm"]}, x),
+            ffn_norm_params=lambda p_l: (p_l["mlp_norm"]["scale"], None),
+            quant_paths=quant_paths,
         )
 
     raise ValueError(
         f"no paged-decode family for {type(model).__name__} (supported: "
         "GPTLM, LlamaLM); non-causal members serve single-forward "
         "requests instead")
+
+
+def quantize_weights(family: _Family, params: dict) -> dict:
+    """The ``--quant=int8_w`` param tree: every decode projection kernel
+    replaced by ``{"q": int8, "scale": f32 per-output-channel}``;
+    embeddings, norms, biases, the head, and MoE expert tensors are the
+    original leaves (shared, not copied)."""
+    out = params
+    for l in range(family.num_layers):
+        for path, caxes in family.quant_paths(l):
+            leaf = params
+            for k in path:
+                leaf = leaf[k]
+            out = _with_path(out, path, _quantize_leaf(leaf, caxes))
+    return out
 
 
 def init_kv_pages(family: _Family, num_pages: int, page_size: int,
@@ -215,17 +390,81 @@ def init_kv_pages(family: _Family, num_pages: int, page_size: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def build_prefill_fn(family: _Family, page_size: int, table_width: int):
+def init_kv_state(family: _Family, num_pages: int, page_size: int,
+                  dtype, quant: str = "off") -> tuple:
+    """The engine's KV carry: ``(k_pages, v_pages)`` — int8 pools plus
+    per-(layer, page) f32 scales under ``int8_kv`` (scales start at 1,
+    matching the zeroed pool)."""
+    if quant == "int8_kv":
+        kp, vp = init_kv_pages(family, num_pages, page_size, jnp.int8)
+        sc = jnp.ones((family.num_layers, num_pages), jnp.float32)
+        return kp, vp, sc, sc
+    return init_kv_pages(family, num_pages, page_size, dtype)
+
+
+def _write_quantized_chunks(pages_q, scales, new, table, length,
+                            page_size, table_width):
+    """Prefill's int8 page write: ``new`` [L, s, kvh, d] chunked into
+    pages, one amax-derived scale per (layer, chunk), chunks past the
+    prompt routed to the trash page 0."""
+    num_layers, s = new.shape[0], new.shape[1]
+    s_pad = _pad_up(s, page_size)
+    if s_pad != s:
+        new = jnp.pad(new, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+    c = s_pad // page_size
+    chunks = new.reshape(num_layers, c, page_size, *new.shape[2:])
+    idx = jnp.arange(c)
+    cpage = jnp.where(idx * page_size < length,
+                      table[jnp.clip(idx, 0, table_width - 1)], 0)
+    amax = jnp.max(jnp.abs(chunks), axis=(2, 3, 4))
+    sc = jnp.maximum(amax / 127.0, _QUANT_EPS)              # [L, c]
+    q = jnp.clip(jnp.round(chunks / sc[:, :, None, None, None]),
+                 -127, 127).astype(jnp.int8)
+    return pages_q.at[:, cpage].set(q), scales.at[:, cpage].set(sc)
+
+
+def _append_quantized(pages_q, scales, page_idx, offset, new):
+    """Decode's int8 append: the touched page is dequantized with its
+    stored scale, the new row written, and the page requantized with a
+    fresh amax — ONE vectorized op over all layers and rows, outside
+    the layer loop.  Rows past the append offset are zeroed BEFORE the
+    amax: a page recycled from a retired request (the allocator never
+    scrubs) still holds the previous occupant's values at those
+    offsets, and trusting them would quantize this request's fresh
+    token with a scale inflated by someone else's garbage (reads are
+    masked either way; the fresh row's precision is what's at stake)."""
+    b = page_idx.shape[0]
+    rows = jnp.arange(b)
+    old = pages_q[:, page_idx]                      # [L, b, ps, kvh, d]
+    sc = scales[:, page_idx]                        # [L, b]
+    page = old.astype(jnp.float32) * sc[..., None, None, None]
+    page_size = page.shape[2]
+    own = (jnp.arange(page_size)[None, :]
+           <= offset[:, None])                      # [b, ps]
+    page = jnp.where(own[None, :, :, None, None], page, 0.0)
+    page = page.at[:, rows, offset].set(new.astype(jnp.float32))
+    amax = jnp.max(jnp.abs(page), axis=(2, 3, 4))
+    new_sc = jnp.maximum(amax / 127.0, _QUANT_EPS)
+    q = jnp.clip(jnp.round(page / new_sc[..., None, None, None]),
+                 -127, 127).astype(jnp.int8)
+    return (pages_q.at[:, page_idx].set(q),
+            scales.at[:, page_idx].set(new_sc))
+
+
+def build_prefill_fn(family: _Family, page_size: int, table_width: int,
+                     quant: str = "off"):
     """The (batch-1, padded prompt bucket) prefill program.
 
-    Args at call time: ``(params, k_pages, v_pages, tokens [1, s],
-    length [], table [w])``.  Returns ``(next_token [1], logits
-    [1, vocab], k_pages, v_pages)`` with the prompt's K/V scattered
-    into the table's pages (pad positions routed to the trash page 0).
+    Args at call time: ``(params, kv, tokens [1, s], length [],
+    table [w])`` where ``kv`` is the engine's KV carry
+    (``init_kv_state``).  Returns ``(next_token [1], logits [1, vocab],
+    kv)`` with the prompt's K/V scattered into the table's pages (pad
+    positions routed to the trash page 0; int8 pools get per-page
+    scales from the chunked write).
     """
     from tpu_hc_bench.parallel.sequence import dense_attention
 
-    def prefill(params, k_pages, v_pages, tokens, length, table):
+    def prefill(params, kv, tokens, length, table):
         s = tokens.shape[1]
         positions = jnp.arange(s)[None, :]
         x = family.embed_prefill(params, tokens)
@@ -249,33 +488,85 @@ def build_prefill_fn(family: _Family, page_size: int, table_width: int):
         x_last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
         logits = family.head(params, x_last)[:, 0]      # [1, vocab]
         next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # scatter the prompt K/V into this request's pages; pads -> trash
         pos = jnp.arange(s)
+        kn = jnp.stack([k[0] for k in new_k])       # [L, s, kvh, d]
+        vn = jnp.stack([v[0] for v in new_v])
+        if quant == "int8_kv":
+            k_pages, v_pages, k_scales, v_scales = kv
+            # zero the pad positions: their (garbage-token) K/V would
+            # otherwise inflate the last page's amax scale
+            valid = (pos < length)[None, :, None, None]
+            kn = jnp.where(valid, kn, 0.0)
+            vn = jnp.where(valid, vn, 0.0)
+            k_pages, k_scales = _write_quantized_chunks(
+                k_pages, k_scales, kn, table, length, page_size,
+                table_width)
+            v_pages, v_scales = _write_quantized_chunks(
+                v_pages, v_scales, vn, table, length, page_size,
+                table_width)
+            return next_token, logits, (k_pages, v_pages,
+                                        k_scales, v_scales)
+        # scatter the prompt K/V into this request's pages; pads -> trash
+        k_pages, v_pages = kv
         page_idx = jnp.where(
             pos < length,
             table[jnp.clip(pos // page_size, 0, table_width - 1)], 0)
         offset = pos % page_size
-        kn = jnp.stack([k[0] for k in new_k])       # [L, s, kvh, d]
-        vn = jnp.stack([v[0] for v in new_v])
         k_pages = k_pages.at[:, page_idx, offset].set(kn)
         v_pages = v_pages.at[:, page_idx, offset].set(vn)
-        return next_token, logits, k_pages, v_pages
+        return next_token, logits, (k_pages, v_pages)
 
     return prefill
 
 
-def build_decode_fn(family: _Family, page_size: int, table_width: int):
+def build_decode_fn(family: _Family, page_size: int, table_width: int,
+                    attention: str = "gather", quant: str = "off",
+                    block_pages: int = 0):
     """The one-token-per-row decode program for a batch bucket.
 
-    Args at call time: ``(params, k_pages, v_pages, tokens [b],
-    tables [b, w], lengths [b], active [b])`` where ``lengths`` is each
-    row's cache depth (== the fed token's position).  Inactive rows
-    compute on the trash page and write back to it; retirement and
-    admission are pure host-side bookkeeping, never a new shape.
-    Returns ``(next_tokens [b], logits [b, vocab], k_pages, v_pages)``.
-    """
+    Args at call time: ``(params, kv, tokens [b], tables [b, w],
+    lengths [b], active [b])`` where ``lengths`` is each row's cache
+    depth (== the fed token's position) and ``kv`` the engine's KV
+    carry.  Inactive rows compute on the trash page and write back to
+    it; retirement and admission are pure host-side bookkeeping, never
+    a new shape.  Returns ``(next_tokens [b], logits [b, vocab], kv)``.
 
-    def decode(params, k_pages, v_pages, tokens, tables, lengths, active):
+    ``attention="gather"`` is the dense-gather reference;
+    ``"paged"`` runs ``ops.paged_decode_attention`` straight over the
+    page tables with ``block_pages`` pages per kernel block and fuses
+    the residual-add+norm pairs (``ops.fused_residual_norm``).
+    """
+    if attention not in DECODE_ATTENTION_ARMS:
+        raise ValueError(f"attention must be one of "
+                         f"{DECODE_ATTENTION_ARMS}: {attention!r}")
+    if quant == "int8_kv" and attention != "paged":
+        raise ValueError("int8_kv scales are consumed inside the paged "
+                         "kernel; the gather reference has no "
+                         "scale-fused read path")
+    ppb = max(1, block_pages)
+
+    def scatter_new(kv, tables, lengths, active, kn, vn):
+        b = lengths.shape[0]
+        rows = jnp.arange(b)
+        page_idx = jnp.where(
+            active,
+            tables[rows, jnp.clip(lengths // page_size, 0,
+                                  table_width - 1)], 0)
+        offset = lengths % page_size
+        if quant == "int8_kv":
+            k_pages, v_pages, k_scales, v_scales = kv
+            k_pages, k_scales = _append_quantized(
+                k_pages, k_scales, page_idx, offset, kn)
+            v_pages, v_scales = _append_quantized(
+                v_pages, v_scales, page_idx, offset, vn)
+            return k_pages, v_pages, k_scales, v_scales
+        k_pages, v_pages = kv
+        k_pages = k_pages.at[:, page_idx, offset].set(kn)
+        v_pages = v_pages.at[:, page_idx, offset].set(vn)
+        return k_pages, v_pages
+
+    def decode_gather(params, kv, tokens, tables, lengths, active):
+        k_pages, v_pages = kv
         b = tokens.shape[0]
         span = table_width * page_size
         x = family.embed_decode(params, tokens, lengths)
@@ -304,16 +595,68 @@ def build_decode_fn(family: _Family, page_size: int, table_width: int):
             x = x + family.ffn(p_l, family.ffn_norm(p_l, x))
         logits = family.head(params, x)[:, 0]
         next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        rows = jnp.arange(b)
-        page_idx = jnp.where(
-            active,
-            tables[rows, jnp.clip(lengths // page_size, 0,
-                                  table_width - 1)], 0)
-        offset = lengths % page_size
         kn = jnp.stack(new_k, axis=0)               # [L, b, kvh, d]
         vn = jnp.stack(new_v, axis=0)
-        k_pages = k_pages.at[:, page_idx, offset].set(kn)
-        v_pages = v_pages.at[:, page_idx, offset].set(vn)
-        return next_tokens, logits, k_pages, v_pages
+        return (next_tokens, logits,
+                scatter_new(kv, tables, lengths, active, kn, vn))
 
-    return decode
+    def decode_paged(params, kv, tokens, tables, lengths, active):
+        if quant == "int8_kv":
+            k_pages, v_pages, k_scales, v_scales = kv
+        else:
+            k_pages, v_pages = kv
+            k_scales = v_scales = None
+        group = family.heads // family.kv_heads
+        scale = 1.0 / family.head_dim ** 0.5
+        x = family.embed_decode(params, tokens, lengths)
+        new_k, new_v = [], []
+        delta = None        # the pending residual add, fused into the
+                            # NEXT norm (ops.fused_residual_norm)
+        for l in range(family.num_layers):
+            p_l = family.layer_params(params, l)
+            if delta is None:
+                h = family.attn_norm(p_l, x)
+            else:
+                g, bta = family.attn_norm_params(p_l)
+                x, h = fused_residual_norm(x, delta, g, bta,
+                                           kind=family.norm_kind)
+            q, k, v = family.qkv(p_l, h, lengths[:, None])
+            new_k.append(k[:, 0])
+            new_v.append(v[:, 0])
+            # the WHOLE pool rides the kernel operand with a static
+            # layer index — a k_pages[l] slice here would materialize
+            # a per-layer pool copy as a temp, the very bytes the
+            # kernel exists to not spend
+            o_cache, lse = paged_decode_attention(
+                q[:, 0], k_pages, v_pages, tables, lengths,
+                pages_per_block=ppb, layer=l, return_lse=True,
+                k_scales=k_scales, v_scales=v_scales)
+            # the fresh token's K/V are not in the pool yet: fold them
+            # into the kernel's online softmax through its logsumexp
+            # (softmax over [cache, fresh] == lse-weighted mix; rows
+            # with an empty cache get lse ~ -inf -> weight 1 on fresh)
+            kf, vf = k[:, 0], v[:, 0]               # [b, kvh, d]
+            if group > 1:
+                kf = jnp.repeat(kf, group, axis=1)
+                vf = jnp.repeat(vf, group, axis=1)
+            s_new = jnp.sum(
+                q[:, 0].astype(jnp.float32) * kf.astype(jnp.float32),
+                axis=-1) * scale                    # [b, heads]
+            w_new = jax.nn.sigmoid(s_new - lse)
+            ctx = (o_cache.astype(jnp.float32)
+                   * (1.0 - w_new)[..., None]
+                   + vf.astype(jnp.float32) * w_new[..., None])
+            a_out = family.attn_out(p_l, ctx.astype(x.dtype)[:, None])
+            g2, b2 = family.ffn_norm_params(p_l)
+            x, h2 = fused_residual_norm(x, a_out, g2, b2,
+                                        kind=family.norm_kind)
+            delta = family.ffn(p_l, h2)
+        x = x + delta
+        logits = family.head(params, x)[:, 0]
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        kn = jnp.stack(new_k, axis=0)               # [L, b, kvh, d]
+        vn = jnp.stack(new_v, axis=0)
+        return (next_tokens, logits,
+                scatter_new(kv, tables, lengths, active, kn, vn))
+
+    return decode_paged if attention == "paged" else decode_gather

@@ -191,6 +191,20 @@ class ServeEngine:
                 "(gpt2*/moe*/llama*) or a classify member")
         self.decode_mode = bool(spec.causal_lm)
         self.max_ctx = cfg.max_prompt_len + cfg.max_output_len
+        # decode-kernel/quant arms (round 18) are decode-lane knobs;
+        # a classify member accepting them would be the silent-no-op
+        # flag the lane contract forbids
+        self.decode_attention = cfg.decode_attention
+        self.quant = cfg.quant
+        self.block_pages = cfg.decode_block_pages or 1
+        if not self.decode_mode and (
+                cfg.decode_attention != "gather" or cfg.quant != "off"
+                or cfg.decode_block_pages):
+            raise ValueError(
+                f"--model {cfg.model} serves single-forward classify "
+                "requests; --decode_attention/--quant/"
+                "--decode_block_pages shape the paged decode step and "
+                "have no meaning here")
 
         dtype = jnp.dtype(cfg.compute_dtype)
         if self.decode_mode:
@@ -258,7 +272,29 @@ class ServeEngine:
             "new_entries": self.entries_after_warmup - entries_before,
             "warm": (self.entries_after_warmup == entries_before
                      and entries_before > 0),
+            "decode_attention": (self.decode_attention
+                                 if self.decode_mode else None),
+            "quant": self.quant,
+            # block pages only exist on the paged arm: reporting the
+            # coerced 1 under gather would render a knob resolve()
+            # itself rejects there
+            "decode_block_pages": (
+                self.block_pages if self.decode_mode
+                and self.decode_attention == "paged" else None),
         }
+        if self.decode_mode:
+            _, worst_decode = self.aot_memory_worst(kinds=("decode",))
+            self.compile_record["aot_decode_temp_bytes"] = (
+                worst_decode.get("temp_bytes") if worst_decode else None)
+            arm = (f"serve decode arm: attention={self.decode_attention} "
+                   f"quant={self.quant}")
+            if self.decode_attention == "paged":
+                arm += f" block_pages={self.block_pages}"
+            tb = self.compile_record["aot_decode_temp_bytes"]
+            if tb is not None:
+                arm += (f"; worst decode bucket AOT temp "
+                        f"{tb / 2**20:.1f} MiB")
+            print_fn(arm)
         kinds = collections.Counter(k for k, _ in self.compiled)
         print_fn(
             "serve warmup: "
@@ -271,6 +307,24 @@ class ServeEngine:
                f" ({'warm start' if self.compile_record['warm'] else 'cold/partial'})"
                if self.cache_dir else ""))
         self._check_hbm_budget(print_fn)
+
+    def aot_memory_worst(self, kinds=None) -> tuple:
+        """``(bucket key, memory_analysis dict)`` of the warmed
+        ladder's worst bucket by AOT total bytes, optionally limited to
+        the given program kinds (``("decode",)`` isolates the decode
+        arm the kernel A/B moves) — ``(None, None)`` where the backend
+        exposes no analysis."""
+        from tpu_hc_bench.obs import memory as obs_memory
+
+        worst, worst_key = None, None
+        for key, compiled in self.compiled.items():
+            if kinds is not None and key[0] not in kinds:
+                continue
+            ma = obs_memory.memory_analysis_of_compiled(compiled)
+            if ma and (worst is None
+                       or ma["total_bytes"] > worst["total_bytes"]):
+                worst, worst_key = ma, key
+        return worst_key, worst
 
     def _check_hbm_budget(self, print_fn) -> None:
         """``--hbm_budget`` in the serving lane: the warmed ladder's
@@ -285,12 +339,7 @@ class ServeEngine:
 
         budget_bytes, note = obs_memory.resolve_hbm_budget_bytes(
             obs_memory.parse_hbm_budget(self.cfg.hbm_budget))
-        worst, worst_key = None, None
-        for key, compiled in self.compiled.items():
-            ma = obs_memory.memory_analysis_of_compiled(compiled)
-            if ma and (worst is None
-                       or ma["total_bytes"] > worst["total_bytes"]):
-                worst, worst_key = ma, key
+        worst_key, worst = self.aot_memory_worst()
         for ln in obs_memory.budget_lines(
                 worst, budget_bytes, note,
                 advice="shrink --serve_buckets/--max_in_flight, "
@@ -320,23 +369,33 @@ class ServeEngine:
         from tpu_hc_bench.serve import decode as decode_mod
 
         jnp = self._jnp
-        self.family = decode_mod.build_family(self.model)
-        kp, vp = decode_mod.init_kv_pages(
+        self.family = decode_mod.build_family(self.model,
+                                              quant=self.quant)
+        # int8_w: the decode programs read the quantized tree; the
+        # original f32 params stay on self.params (parity tests read
+        # them for the full-forward reference)
+        self.exec_params = (
+            decode_mod.quantize_weights(self.family, self.params)
+            if self.quant == "int8_w" else self.params)
+        self._kv = decode_mod.init_kv_state(
             self.family, self.num_pages, self.page_size,
-            jnp.dtype(self.cfg.compute_dtype))
-        self._kv = (kp, vp)
+            jnp.dtype(self.cfg.compute_dtype), quant=self.quant)
         w = self.table_width
         for s in self.prefill_buckets:
-            fn = decode_mod.build_prefill_fn(self.family, self.page_size, w)
-            self._aot(("prefill", s), fn, self.params, kp, vp,
+            fn = decode_mod.build_prefill_fn(
+                self.family, self.page_size, w, quant=self.quant)
+            self._aot(("prefill", s), fn, self.exec_params, self._kv,
                       np.zeros((1, s), np.int32), np.int32(1),
-                      np.zeros((w,), np.int32), donate=(1, 2))
+                      np.zeros((w,), np.int32), donate=(1,))
         for b in self.batch_buckets:
-            fn = decode_mod.build_decode_fn(self.family, self.page_size, w)
-            self._aot(("decode", b), fn, self.params, kp, vp,
+            fn = decode_mod.build_decode_fn(
+                self.family, self.page_size, w,
+                attention=self.decode_attention, quant=self.quant,
+                block_pages=self.block_pages)
+            self._aot(("decode", b), fn, self.exec_params, self._kv,
                       np.zeros((b,), np.int32), np.zeros((b, w), np.int32),
                       np.zeros((b,), np.int32), np.zeros((b,), bool),
-                      donate=(1, 2))
+                      donate=(1,))
 
     def _warm_classify(self) -> None:
         model = self.model
@@ -408,7 +467,7 @@ class ServeEngine:
                     f"{over[0].rid} is {over[0].prompt_len}/"
                     f"{over[0].output_len} — shapes outside the warmed "
                     "buckets never run")
-            kv_k, kv_v = self._kv
+            kv = self._kv
         queue: collections.deque[Request] = collections.deque()
         active: list[_InFlight] = []
         done: list[dict] = []
@@ -447,7 +506,7 @@ class ServeEngine:
                 allocator.free(fl.pages)
 
         def admit(req: Request) -> None:
-            nonlocal kv_k, kv_v, tokens_out, productive_s
+            nonlocal kv, tokens_out, productive_s
             t_admit = now()
             timeline_mod.instant("admit", rid=req.rid)
             if not self.decode_mode:
@@ -461,10 +520,10 @@ class ServeEngine:
             s = pick_bucket(self.prefill_buckets, req.prompt_len)
             toks = np.zeros((1, s), np.int32)
             toks[0, :req.prompt_len] = req.prompt
-            (next_tok, _, kv_k, kv_v), dt = self._timed(
+            (next_tok, _, kv), dt = self._timed(
                 clock, "prefill",
                 lambda: self.compiled[("prefill", s)](
-                    self.params, kv_k, kv_v, toks,
+                    self.exec_params, kv, toks,
                     np.int32(req.prompt_len), table))
             # host-side numpy view BEFORE indexing: jax.Array.__getitem__
             # dispatches a jitted gather — a post-warmup compile the
@@ -485,7 +544,7 @@ class ServeEngine:
                 active.append(fl)
 
         def decode_step() -> None:
-            nonlocal kv_k, kv_v, tokens_out, productive_s
+            nonlocal kv, tokens_out, productive_s
             b = pick_bucket(self.batch_buckets, len(active))
             toks = np.zeros((b,), np.int32)
             tables = np.zeros((b, self.table_width), np.int32)
@@ -496,10 +555,10 @@ class ServeEngine:
                 tables[i] = fl.table
                 lengths[i] = fl.length
                 mask[i] = True
-            (next_toks, _, kv_k, kv_v), dt = self._timed(
+            (next_toks, _, kv), dt = self._timed(
                 clock, "decode",
                 lambda: self.compiled[("decode", b)](
-                    self.params, kv_k, kv_v, toks, tables, lengths, mask))
+                    self.exec_params, kv, toks, tables, lengths, mask))
             steps["decode"] += 1
             tokens_out += len(active)
             productive_s += dt * (len(active) / b)
@@ -585,7 +644,7 @@ class ServeEngine:
                     **{f"{k}_steps": v for k, v in steps.items()})
 
         if self.decode_mode:
-            self._kv = (kv_k, kv_v)
+            self._kv = kv
         wall = max(now(), 1e-9)
         entries_final = self._count_cache()
         fold = slo_mod.fold_requests(done)
@@ -608,6 +667,13 @@ class ServeEngine:
             "max_in_flight": self.cap,
             "kv_page_size": self.page_size,
             "kv_pages": self.num_pages,
+            "decode_attention": (self.decode_attention
+                                 if self.decode_mode else None),
+            "quant": self.quant,
+            "decode_block_pages": self.compile_record.get(
+                "decode_block_pages"),
+            "aot_decode_temp_bytes": self.compile_record.get(
+                "aot_decode_temp_bytes"),
             "post_warmup_compiles": entries_final
                                     - self.entries_after_warmup,
             **{f"{k}_steps": v for k, v in steps.items()},

@@ -38,6 +38,9 @@ DIFF_METRICS = (
     ("tokens/s", "tokens_per_s"),
     ("serve goodput", "goodput"),
     ("queue max", "queue_depth_max"),
+    # round 18: the decode-kernel win — worst decode bucket's AOT temp
+    # bytes (the dense-gather temporaries the paged kernel eliminates)
+    ("aot dec temp B", "aot_decode_temp_bytes"),
 )
 
 
@@ -133,6 +136,15 @@ def slo_lines(fold: dict) -> list[str]:
             f"prefill {fold.get('prefill_steps', 0)} / decode "
             f"{fold.get('decode_steps', 0)} / classify "
             f"{fold.get('classify_steps', 0)}")
+    if fold.get("decode_attention"):
+        tb = fold.get("aot_decode_temp_bytes")
+        lines.append(
+            f"  decode arm: attention={fold['decode_attention']} "
+            f"quant={fold.get('quant', 'off')}"
+            + (f" block_pages={fold['decode_block_pages']}"
+               if fold.get("decode_block_pages") else "")
+            + (f"  worst decode bucket AOT temp {tb / 2**20:.1f} MiB"
+               if tb is not None else ""))
     pwc = fold.get("post_warmup_compiles")
     if pwc is not None:
         lines.append(
@@ -164,6 +176,11 @@ def serve_diff_lines(fold_a: dict | None, fold_b: dict | None) -> list[str]:
         lines.append(f"  note: batching arm differs: "
                      f"{fold_a.get('batching')} -> "
                      f"{fold_b.get('batching')}")
+    for key, label in (("decode_attention", "decode-attention arm"),
+                       ("quant", "quant arm")):
+        if fold_a.get(key) != fold_b.get(key):
+            lines.append(f"  note: {label} differs: "
+                         f"{fold_a.get(key)} -> {fold_b.get(key)}")
     return lines
 
 

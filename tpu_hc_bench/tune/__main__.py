@@ -98,16 +98,23 @@ def _render_journal(journal: dict) -> None:
         for rung, rec in sorted((meas or {}).items()):
             if not isinstance(rec, dict):
                 continue
+            # the key IS the lever assignment (kernel/block-size levers
+            # like decode_attention=paged,decode_block_pages=2 render
+            # here verbatim), so every measured row names its config
+            parts = []
+            if rec.get("score") is not None:
+                parts.append(f"score {rec['score']:.4g}")
             peak = rec.get("peak_hbm_bytes")
-            if not peak:
-                continue
-            limit = rec.get("hbm_bytes_limit")
-            print(f"  measured: {key} rung {rung}: peak "
-                  f"{peak / 2**20:.1f} MiB"
-                  + (f" of {limit / 2**30:.1f} GiB "
-                     f"({peak / limit:.0%})" if limit else "")
-                  + (f" [{rec['mem_source']}]"
-                     if rec.get("mem_source") else ""))
+            if peak:
+                limit = rec.get("hbm_bytes_limit")
+                parts.append(
+                    f"peak {peak / 2**20:.1f} MiB"
+                    + (f" of {limit / 2**30:.1f} GiB "
+                       f"({peak / limit:.0%})" if limit else "")
+                    + (f" [{rec['mem_source']}]"
+                       if rec.get("mem_source") else ""))
+            print(f"  measured: {key} rung {rung}"
+                  + (": " + "; ".join(parts) if parts else ""))
 
 
 def _cmd_show(args) -> int:

@@ -55,17 +55,23 @@ LEVERS = (
 
 # The serving lane's levers (round 16, ``tpu_hc_bench.serve``): the
 # decode bucket ladder, the continuous-batching admission cap, and the
-# paged-KV geometry.  All are BenchmarkConfig fields, so the halving
-# search, the journal, and ``--config=auto`` handle serve candidates
-# with the same machinery — a serve candidate just carries
-# ``workload="serve"`` so the pruner's flag-time resolve() runs the
-# serving validity matrix, and its registry row is keyed
-# ``<model>@serve`` (one member can hold a tuned row per lane).
+# paged-KV geometry; round 18 adds the decode-kernel arm (gather
+# reference vs the Pallas paged flash-decode kernel), its page-block
+# size, and the quantization arm — kernels are autotuned like any
+# other lever.  All are BenchmarkConfig fields, so the halving search,
+# the journal, and ``--config=auto`` handle serve candidates with the
+# same machinery — a serve candidate just carries ``workload="serve"``
+# so the pruner's flag-time resolve() runs the serving validity
+# matrix, and its registry row is keyed ``<model>@serve`` (one member
+# can hold a tuned row per lane).
 SERVE_LEVERS = (
     "serve_buckets",
     "max_in_flight",
     "kv_page_size",
     "kv_pages",
+    "decode_attention",
+    "quant",
+    "decode_block_pages",
 )
 
 # member -> best-known single-chip config (BASELINE.md zoo table).
@@ -137,6 +143,7 @@ SEED_SERVE_CONFIGS: dict[str, dict] = {
 }
 
 _KV_PAGE_LADDER = (8, 16, 32)
+_DECODE_BLOCK_PAGES_LADDER = (2, 4)
 
 _ACCUM_LADDER = (1, 2, 4, 8, 16, 32, 64)
 _FUSION_LADDER = (DEFAULT_FUSION_THRESHOLD_BYTES,
@@ -243,6 +250,18 @@ def _pow2_ladder(center: int, down: int = 2, up: int = 2) -> list[int]:
         if v >= 1 and v not in out:
             out.append(int(v))
     return out
+
+
+def _member_decodes(model: str) -> bool:
+    """True for causal-LM members (the serve lane's decode families);
+    best-effort like ``_member_levers`` so the module stays importable
+    without the models package."""
+    try:
+        from tpu_hc_bench.models import get_model_spec
+
+        return bool(get_model_spec(model).causal_lm)
+    except Exception:
+        return False
 
 
 def _member_levers(model: str) -> dict[str, bool]:
@@ -370,10 +389,16 @@ def serve_member_space(model: str,
     more rows per decode step vs deeper queues), the KV page size
     (coarser pages waste tail tokens, finer pages widen the gather
     tables), the pool size (auto vs a half pool — queueing for pages vs
-    HBM held), and the bucket ladder shape (the full power-of-two
-    ladder vs one top-bucket — per-compile cost vs padding waste).
-    Structural validity beyond this is ``resolve()``'s serving matrix,
-    reached by the pruner's flag-time check.
+    HBM held), the bucket ladder shape (the full power-of-two
+    ladder vs one top-bucket — per-compile cost vs padding waste), and
+    — decoder members only (round 18) — the decode-kernel arms: the
+    paged Pallas flash-decode kernel vs the gather reference, its
+    page-block size, and the int8 weight/KV quantization arms.
+    Structurally-coupled levers are generated together (``int8_kv``
+    and ``decode_block_pages`` only exist on the paged arm — the
+    combinations ``resolve()`` would reject are never emitted);
+    validity beyond this is ``resolve()``'s serving matrix, reached by
+    the pruner's flag-time check.
     """
     seed = seed or serve_seed_candidate(model)
     if seed.workload != "serve":
@@ -404,6 +429,16 @@ def serve_member_space(model: str,
     for p in _KV_PAGE_LADDER:
         if p != page:
             vary(kv_page_size=p)
+    # decode-kernel arms (decoder members only; classify members have
+    # no decode step for these to shape)
+    if _member_decodes(model):
+        vary(decode_attention="paged")
+        for ppb in _DECODE_BLOCK_PAGES_LADDER:
+            vary(decode_attention="paged", decode_block_pages=ppb)
+        vary(quant="int8_w")
+        # int8_kv's per-page scales are consumed inside the paged
+        # kernel, so the arm only exists there
+        vary(decode_attention="paged", quant="int8_kv")
     # one top bucket: a single compiled decode shape, every step padded
     # to the cap (the compile-count-vs-padding tradeoff made explicit)
     vary(serve_buckets=str(cap))
