@@ -42,6 +42,12 @@ def _example_argvs():
 def test_readme_cli_example_parses(argv):
     from tpu_hc_bench.parallel.fabric import resolve_fabric
 
+    if argv and argv[0] == "fleet":
+        # the fleet subcommand (round 19) has its own argparse surface
+        from tpu_hc_bench.fleet.__main__ import build_parser
+
+        build_parser().parse_args(argv[1:])
+        return
     pos, rest = launcher.parse_positionals(argv)
     assert len(pos) in (0, 4), f"positional contract violated: {pos}"
     cfg = flags.parse_flags(rest)

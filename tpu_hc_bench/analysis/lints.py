@@ -56,6 +56,12 @@ shipped) are checked statically:
   timestamp into the compiled program and the span lies in every
   execution after the first.  Recorder calls wrap the *dispatch* of
   compiled work (the driver/serve-engine idiom), never live inside it.
+- **fleet-blocking-wait** (error): a no-timeout ``.wait()``/``.join()``
+  inside a loop body under ``tpu_hc_bench/fleet/`` — the fleet control
+  loop is one thread supervising N jobs, and an unbounded block on any
+  single process/thread freezes scheduling (reaps, liveness, churn)
+  for the whole pool.  Bounded forms (``wait(5)``,
+  ``join(timeout=...)``) and poll+sleep loops pass.
 - **sharding-consistency** (warning): per model, the Megatron
   annotation table (``train.step.tp_param_spec``) is replayed against
   the abstractly-initialized param tree: a rule whose *name* matches a
@@ -97,9 +103,10 @@ HOT_MEMORY = "memory-probe-in-hot-loop"
 SERVE_RECOMPILE = "serve-bucket-recompile"
 SPAN_IN_JIT = "span-in-compiled-fn"
 DEQUANT_HOT = "dequantize-in-hot-loop"
+FLEET_WAIT = "fleet-blocking-wait"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
                     INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE, SPAN_IN_JIT,
-                    DEQUANT_HOT)
+                    DEQUANT_HOT, FLEET_WAIT)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -800,6 +807,52 @@ class _FileLinter:
                 "every execution; record around the jitted call, not "
                 "inside it (obs.timeline is host-side by contract)")
 
+    # -- fleet-blocking-wait -------------------------------------------
+
+    # no-arg blocking callees: `.wait()` (Popen, Event, Condition) and
+    # `.join()` (Thread, Process) block FOREVER without a timeout
+    _BLOCKING_CALLEES = {"wait", "join"}
+
+    def _in_fleet_package(self) -> bool:
+        parts = Path(self.filename).as_posix().split("/")
+        return "fleet" in parts and "tests" not in parts
+
+    def _check_fleet_blocking_wait(self):
+        """**fleet-blocking-wait** (error, fleet package only): a
+        ``.wait()``/``.join()`` call with no timeout inside a loop body
+        of the fleet scheduler/supervisor.
+
+        The control loop is the one thread keeping N jobs alive: an
+        unbounded wait on any single job (a Popen that never exits, a
+        thread stuck in I/O) freezes scheduling for the WHOLE fleet —
+        no reaps, no liveness checks, no admissions — which is exactly
+        the hang class the per-job watchdog cannot see from inside the
+        job.  The accepted idiom is poll + bounded sleep (the
+        supervisor's ``reap``) or an explicit timeout argument; a
+        ``wait(5)``/``join(timeout=...)`` call is bounded and passes.
+        Loop headers and nested function definitions are exempt through
+        the same loop-body walk as the hot-loop passes.
+        """
+        if not self._in_fleet_package():
+            return
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._BLOCKING_CALLEES):
+                continue
+            if node.args or node.keywords:
+                continue        # any argument bounds (or re-purposes) it
+            if self._enclosing_loop_body(node) is None:
+                continue
+            name = _dotted(node.func) or f"<expr>.{node.func.attr}"
+            self._emit(
+                FLEET_WAIT, "error", node,
+                f"unbounded `{name}()` inside a fleet control loop — "
+                "one wedged job blocks scheduling for every other job; "
+                "pass a timeout (`.wait(grace_s)` / "
+                "`.join(timeout=...)`) or poll with a bounded sleep "
+                "like supervisor.reap")
+
     # -- serve-bucket-recompile ----------------------------------------
 
     # calls that lower/trace a program (and so can compile a NEW shape):
@@ -866,6 +919,7 @@ class _FileLinter:
         self._check_memory_probe_hot_loop()
         self._check_dequant_hot_loop()
         self._check_serve_recompile()
+        self._check_fleet_blocking_wait()
         return self.findings
 
 

@@ -1,0 +1,50 @@
+"""Fleet orchestrator: many training jobs, one device pool.
+
+The production story on the training side ("heavy traffic from
+millions of users" — ROADMAP): N zoo members packed onto one shared
+pool of chips, kept at high fleet-wide goodput while jobs are
+continuously killed and resized by spot churn and priority arrivals.
+Nothing here invents new machinery — the subsystem is a composition of
+contracts the single-job layers already pin:
+
+- the launcher's **exit-code contract** (0/1/70/75 —
+  ``resilience.EXIT_CLASSES``) classifies every death;
+- **graceful preemption** (``resilience.preempt``): the supervisor's
+  SIGTERM rides the same emergency-checkpoint path as a spot notice;
+- **elastic resume** (``--resume=elastic``, round 12): a preempted job
+  relaunches at whatever world the scheduler can grant, not just the
+  world it lost;
+- the **measured HBM model** (``tune/prune.hbm_model_for``) refuses
+  admissions that would OOM, measured anchors first;
+- **heartbeats + incarnation counters** (``obs/fleet``) give the
+  supervisor liveness, and the **flight recorder** (``obs/timeline``)
+  gives the report per-job span timelines.
+
+Modules: ``pool`` (chips + HBM admission, the JobSpec contract),
+``scheduler`` (pure priority/gang/grow policy), ``supervisor``
+(process lifecycle + the control loop), ``churn`` (deterministic
+seeded kill/shrink/arrival schedules), ``report`` (the fleet goodput
+ledger and the soak verdict artifact).  CLI::
+
+    python -m tpu_hc_bench.fleet run --demo --chips 8 --out /tmp/fleet
+    python -m tpu_hc_bench.fleet status /tmp/fleet
+    python -m tpu_hc_bench.fleet report /tmp/fleet --control /tmp/ctl
+"""
+
+from tpu_hc_bench.fleet.churn import ChurnEvent, parse_churn, seeded_churn
+from tpu_hc_bench.fleet.pool import DevicePool, HbmVerdict, JobSpec
+from tpu_hc_bench.fleet.report import fleet_ledger, write_verdict
+from tpu_hc_bench.fleet.scheduler import Decision, plan
+from tpu_hc_bench.fleet.supervisor import (
+    FleetController,
+    LocalBackend,
+    Supervisor,
+)
+
+__all__ = [
+    "ChurnEvent", "parse_churn", "seeded_churn",
+    "DevicePool", "HbmVerdict", "JobSpec",
+    "fleet_ledger", "write_verdict",
+    "Decision", "plan",
+    "FleetController", "LocalBackend", "Supervisor",
+]

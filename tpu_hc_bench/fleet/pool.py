@@ -1,0 +1,175 @@
+"""The device pool and the job-spec contract.
+
+One physical pool (``chips`` on this "slice" — virtual CPU devices in
+the container, real chips on hardware), many jobs.  Two admission
+questions are answered here, both *before* a process is spawned:
+
+- **Chips** — gang semantics: a job holds ``world`` chips or none.
+  Reservations are plain bookkeeping (``reserve``/``release``) with a
+  hard overcommit invariant; *which* world a job gets is the
+  scheduler's decision, the pool only says whether it fits.
+
+- **HBM** — a job whose per-chip microbatch cannot fit a chip's memory
+  will OOM 50 warmup steps in, burning its gang's chip-seconds for
+  nothing.  ``hbm_admission`` reuses the autotuner's known-OOM model
+  (``tune/prune.hbm_model_for``): measured anchors from prior run
+  journals win, the seeded best-known-config guess is the fallback,
+  and every verdict carries its provenance (``measured|seeded``) so a
+  refusal can say *why* it believed the job would not fit.  The
+  launcher's ``--batch_size`` is per-worker (README), so the per-chip
+  microbatch — batch / accum — is world-independent and the check runs
+  once per spec, not per candidate world.
+
+The job spec is the fleet's unit of work: a zoo member plus the gang
+geometry (preferred and minimum world), a priority, an arrival time,
+and the run length.  ``JobSpec.from_dict``/``to_dict`` define the
+``fleet run --spec jobs.json`` file format documented in the README.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["JobSpec", "DevicePool", "HbmVerdict"]
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One training job in the fleet.
+
+    ``batch_size`` is the launcher's per-worker batch (global batch
+    scales with the world the scheduler grants).  ``world_pref`` is the
+    gang size the job wants; ``world_min`` the smallest world it is
+    worth running at — the scheduler shrinks between the two, never
+    below.  Higher ``priority`` preempts lower.  ``arrival_s`` is when
+    the job enters the queue (fleet-relative seconds — the churn
+    schedule's priority-arrival events use it).  ``flags`` are extra
+    driver flags passed through verbatim.
+    """
+
+    name: str
+    model: str
+    batch_size: int
+    world_pref: int
+    world_min: int = 1
+    priority: int = 0
+    arrival_s: float = 0.0
+    batches: int = 60
+    warmup: int = 2
+    accum: int = 1
+    save_every: int = 2
+    flags: tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if not self.name or "/" in self.name:
+            raise ValueError(f"job name must be a plain token: "
+                             f"{self.name!r}")
+        if self.world_min < 1 or self.world_pref < self.world_min:
+            raise ValueError(
+                f"{self.name}: need 1 <= world_min <= world_pref, got "
+                f"min={self.world_min} pref={self.world_pref}")
+        if self.batch_size < 1 or self.accum < 1:
+            raise ValueError(f"{self.name}: batch/accum must be >= 1")
+
+    @property
+    def microbatch(self) -> int:
+        """The per-chip activation-memory unit (batch / accum) — the
+        quantity the HBM admission check anchors on."""
+        return max(1, self.batch_size // self.accum)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["flags"] = list(self.flags)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "JobSpec":
+        known = {f.name for f in dataclasses.fields(JobSpec)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"job spec {d.get('name', '?')!r}: unknown field(s) "
+                f"{sorted(unknown)} (known: {sorted(known)})")
+        d = dict(d)
+        d["flags"] = tuple(d.get("flags") or ())
+        return JobSpec(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class HbmVerdict:
+    fits: bool
+    reason: str | None      # refusal reason (None when it fits)
+    source: str             # measured | seeded | unknown
+
+
+class DevicePool:
+    """Chip reservations for one shared pool, gang-or-nothing.
+
+    ``measured_rows`` (tune-journal measurement rows joined with their
+    overrides — ``tune.prune.measured_rows_from_journal``) feed the HBM
+    model its measured anchors; without them the seeded best-known
+    configs are the fallback, and members outside the seed table admit
+    with ``source="unknown"`` (no memory knowledge beats refusing every
+    unknown member).
+    """
+
+    def __init__(self, chips: int,
+                 measured_rows: list[dict] | None = None):
+        if chips < 1:
+            raise ValueError(f"pool needs >= 1 chip, got {chips}")
+        self.chips = chips
+        self.measured_rows = list(measured_rows or [])
+        self.held: dict[str, int] = {}
+        self._hbm_cache: dict[tuple, HbmVerdict] = {}
+
+    @property
+    def free(self) -> int:
+        return self.chips - sum(self.held.values())
+
+    def can_reserve(self, world: int) -> bool:
+        return 1 <= world <= self.free
+
+    def reserve(self, name: str, world: int) -> None:
+        if name in self.held:
+            raise ValueError(f"{name} already holds "
+                             f"{self.held[name]} chip(s)")
+        if not self.can_reserve(world):
+            raise ValueError(
+                f"cannot reserve {world} chip(s) for {name}: "
+                f"{self.free} of {self.chips} free")
+        self.held[name] = world
+
+    def release(self, name: str) -> int:
+        return self.held.pop(name, 0)
+
+    def hbm_admission(self, spec: JobSpec) -> HbmVerdict:
+        """Would one chip hold this job's microbatch?  Measured-anchors-
+        first through ``tune.prune.hbm_model_for`` — the ONE provenance
+        rule — with the verdict cached per (model, batch, accum).
+
+        The pool holds one row list for the whole fleet, so rows are
+        filtered to THIS spec's model here (each ``tune/runner`` record
+        carries its ``model``); a row without the field is dropped —
+        a lenet memory profile must never anchor a bert admission.
+        """
+        key = (spec.model, spec.batch_size, spec.accum)
+        hit = self._hbm_cache.get(key)
+        if hit is not None:
+            return hit
+        from tpu_hc_bench.tune.prune import hbm_model_for
+        from tpu_hc_bench.tune.space import Candidate
+
+        rows = [r for r in self.measured_rows
+                if r.get("model") == spec.model]
+        model = hbm_model_for(spec.model, rows or None)
+        if model is None:
+            verdict = HbmVerdict(True, None, "unknown")
+        else:
+            overrides = {"batch_size": spec.batch_size}
+            if spec.accum > 1:
+                overrides["gradient_accumulation_steps"] = spec.accum
+            reason = model.check(
+                Candidate.make(spec.model, overrides))
+            verdict = HbmVerdict(reason is None, reason, model.source)
+        self._hbm_cache[key] = verdict
+        return verdict

@@ -51,13 +51,23 @@ def parse_positionals(argv: list[str]):
             "usage: python -m tpu_hc_bench [NUM_HOSTS WORKERS_PER_HOST "
             "BATCH_SIZE FABRIC(ib|sock|ici|dcn|host)] [--tf_cnn_flags...]\n"
             "       python -m tpu_hc_bench serve [--serve_flags...]  "
-            "(request-driven serving benchmark)"
+            "(request-driven serving benchmark)\n"
+            "       python -m tpu_hc_bench fleet run|status|report ...  "
+            "(multi-job fleet orchestrator)"
         )
     return pos, rest
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "fleet":
+        # the fleet orchestrator (round 19): many jobs, one device pool
+        # — `python -m tpu_hc_bench fleet run|status|report ...`
+        # (tpu_hc_bench.fleet); each job is itself a launcher
+        # subprocess under the positional contract below
+        from tpu_hc_bench.fleet import __main__ as fleet_cli
+
+        return fleet_cli.main(argv[1:])
     if argv and argv[0] == "serve":
         # the serving lane (round 16): `python -m tpu_hc_bench serve
         # [--tf_flags...]` — request-driven benchmark with continuous

@@ -66,32 +66,49 @@ class StepEwma:
         return self.ewma_ms
 
 
-def _next_incarnation(path: str) -> int:
-    """This life's incarnation counter: one more than the last record's
-    in the existing heartbeat file (0 for a fresh file).  Reads only the
-    file tail — heartbeat files grow O(run) and this runs at startup."""
+def _tail_record(path: str, nbytes: int = 8192) -> dict | None:
+    """The newest parseable JSON record in the file's tail — heartbeat
+    files grow O(run), and every per-tick/startup reader must stay
+    O(1), not re-parse the whole history."""
     try:
         size = os.path.getsize(path)
     except OSError:
-        return 0
+        return None
     if size == 0:
-        return 0
+        return None
     try:
         with open(path, "rb") as f:
-            f.seek(max(0, size - 8192))
+            f.seek(max(0, size - nbytes))
             tail = f.read().decode("utf-8", errors="replace")
     except OSError:
-        return 0
+        return None
     for line in reversed(tail.splitlines()):
         line = line.strip()
         if not line:
             continue
         try:
-            rec = json.loads(line)
+            return json.loads(line)
         except json.JSONDecodeError:
             continue
-        return int(rec.get("incarnation", 0) or 0) + 1
-    return 1    # non-empty file with no parseable tail: still a relaunch
+    return None
+
+
+def next_incarnation(path: str) -> int:
+    """The incarnation counter the NEXT ``FleetWriter`` on this file
+    will stamp: one more than the last record's (0 for a fresh file).
+    Public because the fleet supervisor derives its expected
+    incarnation from the SAME file tail at launch time — deriving it
+    from a launch count instead would drift permanently ahead the
+    first time a life dies before its first beat."""
+    try:
+        if os.path.getsize(path) == 0:
+            return 0
+    except OSError:
+        return 0
+    rec = _tail_record(path)
+    if rec is None:
+        return 1    # non-empty file with no parseable tail: a relaunch
+    return int(rec.get("incarnation", 0) or 0) + 1
 
 
 class FleetWriter:
@@ -126,7 +143,7 @@ class FleetWriter:
         self.process_index = process_index
         os.makedirs(out_dir, exist_ok=True)
         path = heartbeat_path(out_dir, process_index)
-        self.incarnation = _next_incarnation(path)
+        self.incarnation = next_incarnation(path)
         self._f = open(path, "a")
 
     @property
@@ -209,6 +226,66 @@ def compute_skew(host_steps: list[int],
 
 
 # ---------------------------------------------------------------------
+# liveness (heartbeat staleness — shared by the fleet supervisor and
+# `obs watch`)
+
+ALIVE = "ALIVE"
+STALE = "STALE"
+DEAD = "DEAD"
+
+#: default staleness thresholds, in seconds of heartbeat silence.  A
+#: heartbeat lands once per sync window (seconds at most), so tens of
+#: seconds of silence is a wedged host, not a slow one.
+STALE_AFTER_S = 15.0
+DEAD_AFTER_S = 60.0
+
+
+def classify_liveness(recs: list[dict], now: float | None = None,
+                      stale_after_s: float = STALE_AFTER_S,
+                      dead_after_s: float = DEAD_AFTER_S,
+                      expect_incarnation: int | None = None) -> dict:
+    """ALIVE/STALE/DEAD verdict over one rank's heartbeat records.
+
+    The signal is the NEWEST heartbeat's wall-clock age plus its
+    incarnation counter: a file whose freshest beat is older than
+    ``dead_after_s`` belongs to a process that stopped beating (killed,
+    hung past the watchdog, or wedged in uninterruptible I/O) — exactly
+    the state the pre-round-19 ``watch`` rendered as silently-old
+    numbers.  ``expect_incarnation`` (the fleet supervisor's relaunch
+    counter) guards the elastic-resume window: a beat from an OLDER
+    life must not count as the new life's sign of life, so it reports
+    at most STALE until the expected incarnation appears.
+
+    Returns ``{"status", "age_s", "step", "incarnation"}``; no records
+    at all classify DEAD with ``age_s=None`` (a job that never beat).
+    """
+    now = time.time() if now is None else now
+    newest = None
+    for rec in recs:
+        if rec.get("kind") != "heartbeat":
+            continue
+        if newest is None or rec.get("t_unix", 0) >= newest.get("t_unix", 0):
+            newest = rec
+    if newest is None:
+        return {"status": DEAD, "age_s": None, "step": None,
+                "incarnation": None}
+    age = max(0.0, now - float(newest.get("t_unix", now)))
+    inc = int(newest.get("incarnation", 0) or 0)
+    if expect_incarnation is not None and inc < expect_incarnation:
+        # an old life's beat: fresh-looking numbers, wrong process —
+        # never ALIVE, DEAD once the old beat itself has aged out
+        status = DEAD if age > dead_after_s else STALE
+    elif age > dead_after_s:
+        status = DEAD
+    elif age > stale_after_s:
+        status = STALE
+    else:
+        status = ALIVE
+    return {"status": status, "age_s": age,
+            "step": newest.get("step"), "incarnation": inc}
+
+
+# ---------------------------------------------------------------------
 # reading (pure file ops)
 
 
@@ -220,10 +297,32 @@ def heartbeat_mem_peak(rec: dict) -> int | None:
     return int(v) if v else None
 
 
+def latest_heartbeats(run_dir: str) -> dict[int, dict]:
+    """Each host's NEWEST heartbeat record, by bounded tail read — the
+    fleet supervisor's per-tick liveness source (``read_heartbeats``
+    parses the whole history; calling that every scheduler tick would
+    make the control loop's cost grow with run length)."""
+    out: dict[int, dict] = {}
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return out
+    for name in sorted(names):
+        m = _HEARTBEAT_RE.match(name)
+        if not m:
+            continue
+        rec = _tail_record(os.path.join(run_dir, name))
+        if rec is not None:
+            out[int(m.group(1))] = rec
+    return out
+
+
 def read_heartbeats(run_dir: str) -> dict[int, list[dict]]:
     """All hosts' heartbeat records, keyed by process index.  Corrupt
     lines (a heartbeat interrupted by the very death it reports) are
     skipped silently — partial fleet state beats none."""
+    from tpu_hc_bench.obs.metrics import read_jsonl
+
     out: dict[int, list[dict]] = {}
     try:
         names = os.listdir(run_dir)
@@ -233,18 +332,7 @@ def read_heartbeats(run_dir: str) -> dict[int, list[dict]]:
         m = _HEARTBEAT_RE.match(name)
         if not m:
             continue
-        host = int(m.group(1))
-        recs = []
-        with open(os.path.join(run_dir, name)) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    recs.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue
-        out[host] = recs
+        out[int(m.group(1))] = read_jsonl(os.path.join(run_dir, name))
     return out
 
 

@@ -271,6 +271,28 @@ class MetricsWriter:
 # reading / summarize / diff (pure file ops — no jax)
 
 
+def read_jsonl(path: str) -> list[dict]:
+    """Tolerant JSONL read: blank and corrupt lines skipped (a stream
+    interrupted by the very death it documents must still render), an
+    unreadable file is an empty list.  The ONE copy of this loop —
+    heartbeat files, fleet journals, and harvest all read through it.
+    """
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
 def resolve_run(path: str) -> tuple[str | None, str]:
     """Resolve a run path to ``(manifest_path_or_None, metrics_path)``.
 

@@ -48,6 +48,10 @@ CHECKS = (
     # bucket's AOT temp bytes means the paged arm regressed toward the
     # dense-gather temporaries it exists to eliminate
     (("extra", "aot_decode_temp_bytes"), "lower", "aot decode temp B"),
+    # round 19: the fleet soak — goodput-weighted chip-seconds over
+    # pool chip-seconds under churn; a drop means the scheduler started
+    # wasting the pool (thrash, slow readmission, orphaned capacity)
+    (("extra", "fleet_goodput"), "higher", "fleet goodput"),
 )
 
 #: identity fields folded into the fingerprint (record path order)

@@ -78,10 +78,15 @@ def render(path: str, manifest: dict, records: list[dict],
     # each rank is stuck, not just that its step counter stopped
     last_beats = {h: recs[-1] for h, recs in sorted(beats.items()) if recs}
     if any(r.get("phase") for r in last_beats.values()):
+        # liveness column (round 19): ALIVE/STALE/DEAD from the newest
+        # beat's wall age — a wedged rank says so instead of silently
+        # showing its last good numbers forever
         for h, r in list(last_beats.items())[:8]:
-            age = time.time() - r.get("t_unix", time.time())
+            live = fleet_mod.classify_liveness([r])
+            age = live["age_s"] or 0.0
             lines.append(
-                f"  rank{h}: step {r.get('step', '?')}  "
+                f"  rank{h}: {live['status']:<5}  "
+                f"step {r.get('step', '?')}  "
                 f"phase {r.get('phase') or '?'}  "
                 f"beat {age:.0f}s ago"
                 + (f"  (incarnation {r['incarnation']})"

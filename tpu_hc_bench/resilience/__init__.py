@@ -44,12 +44,54 @@ EXIT_PREEMPTED = 75         # SIGTERM/SIGINT honored: emergency
                             # checkpoint written, relaunch with
                             # --resume=auto to continue (EX_TEMPFAIL)
 
-from tpu_hc_bench.resilience.guards import (   # noqa: E402
-    GuardBudgetError, NonFiniteError,
-)
-from tpu_hc_bench.resilience.preempt import PreemptedError  # noqa: E402
+# The contract as a classification table: exit code -> class token
+# (None = clean success).  This is the ONE home — the tuner's runner,
+# the sweep, and the fleet supervisor all consume it from here; two
+# drifting copies would mean a scheduler reacting to a code the
+# launcher no longer emits (the regex-miscount failure mode of
+# ADVICE.md round 5, relocated to process management).
+EXIT_CLASSES: dict[int, str | None] = {
+    EXIT_OK: None,
+    EXIT_ZERO_THROUGHPUT: "zero-throughput",
+    EXIT_WATCHDOG: "watchdog-timeout",
+    EXIT_PREEMPTED: "preempted",
+}
+
+
+def classify_exit(code: int) -> str | None:
+    """The exit-code contract as one lookup: None for a clean run, the
+    class token for a contract code, ``exit-<n>`` for anything else
+    (a crash outside the contract), and ``signal-<n>`` for a negative
+    subprocess returncode (killed by signal n before the handler ran —
+    the no-emergency-checkpoint death the fleet must treat as crash,
+    not preemption)."""
+    if code in EXIT_CLASSES:
+        return EXIT_CLASSES[code]
+    if code < 0:
+        return f"signal-{-code}"
+    return f"exit-{code}"
+
+
+# The error re-exports resolve lazily (PEP 562): ``guards`` pulls in
+# jax/optax (~10s cold on this container), and the exit-code table
+# above must stay importable by pure process-orchestration code (the
+# tune runner, the fleet supervisor) that never touches a device.
+_LAZY = {
+    "GuardBudgetError": "tpu_hc_bench.resilience.guards",
+    "NonFiniteError": "tpu_hc_bench.resilience.guards",
+    "PreemptedError": "tpu_hc_bench.resilience.preempt",
+}
 
 __all__ = [
     "EXIT_OK", "EXIT_ZERO_THROUGHPUT", "EXIT_WATCHDOG", "EXIT_PREEMPTED",
+    "EXIT_CLASSES", "classify_exit",
     "GuardBudgetError", "NonFiniteError", "PreemptedError",
 ]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,13 +1,21 @@
-"""The ONE subprocess runner behind the sweep and the tuner.
+"""The ONE subprocess runner behind the sweep, the tuner, and the fleet.
 
-``scripts/sweep_zoo.py`` and the successive-halving search both need
-the same thing: launch ``python -m tpu_hc_bench 1 0 <batch> ici
---model=<m> <flags...>`` in a subprocess, enforce a timeout, classify
-the launcher's exit-code contract (0 ok / 1 zero-throughput / 70
-watchdog / 75 preempted — ``tpu_hc_bench.resilience``), and parse one
+``scripts/sweep_zoo.py``, the successive-halving search, and the fleet
+supervisor (``tpu_hc_bench.fleet``) all need the same thing: launch
+``python -m tpu_hc_bench 1 0 <batch> ici --model=<m> <flags...>`` in a
+subprocess, enforce a timeout, classify the launcher's exit-code
+contract (0 ok / 1 zero-throughput / 70 watchdog / 75 preempted —
+``tpu_hc_bench.resilience.EXIT_CLASSES``, the one home), and parse one
 result record.  Two diverging copies of that logic is how the old
 regex miscounting bugs happened (ADVICE.md round 5), so it lives here
 once.
+
+Every launch puts the job in its OWN process group
+(``start_new_session=True``) and every kill targets the *group*
+(``kill_process_tree``): a training job hosts feeder threads, decode
+pools, and — under the input service — real grandchild processes, and
+a timeout/preempt that only killed the direct child would orphan them
+onto the fleet's CPUs (the supervisor's zero-orphan soak invariant).
 
 Result parsing prefers the machine-readable path: with ``metrics_dir``
 set, the run's ``metrics.jsonl`` final ``summary`` record (the
@@ -26,19 +34,114 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
-__all__ = ["run_one", "score", "parse_stdout_metrics", "EXIT_CLASSES"]
+# launcher exit-code contract (README "Fault tolerance" table) — the
+# table lives with the codes in ``resilience``; this name is the
+# long-standing import point for the sweep/tuner call sites
+from tpu_hc_bench.resilience import EXIT_CLASSES, classify_exit
 
-# launcher exit-code contract (README "Fault tolerance" table)
-EXIT_CLASSES = {
-    0: None,
-    1: "zero-throughput",
-    70: "watchdog-timeout",
-    75: "preempted",
-}
+__all__ = ["run_one", "score", "parse_stdout_metrics", "EXIT_CLASSES",
+           "classify_exit", "build_cmd", "launch_one",
+           "kill_process_tree"]
+
+
+def build_cmd(
+    model: str,
+    batch: int,
+    flags: list[str] | None = None,
+    *,
+    warmup: int = 25,
+    batches: int = 60,
+    use_fp16: bool = True,
+    workers: int = 0,
+) -> list[str]:
+    """The launcher command line for one member config (the positional
+    ``NUM_HOSTS WORKERS BATCH FABRIC`` contract + tf_cnn-style flags).
+    Shared by the blocking ``run_one`` and the fleet supervisor's
+    non-blocking ``launch_one`` so there is exactly one spelling of the
+    job-spec → argv translation."""
+    cmd = [
+        sys.executable, "-m", "tpu_hc_bench", "1", str(workers),
+        str(batch), "ici",
+        f"--model={model}",
+        f"--num_warmup_batches={warmup}", f"--num_batches={batches}",
+    ]
+    if use_fp16:
+        cmd.append("--use_fp16=True")
+    cmd.extend(flags or [])
+    return cmd
+
+
+def launch_one(cmd: list[str], *, env: dict | None = None,
+               cwd: str | None = None, stdout=None,
+               stderr=subprocess.STDOUT) -> subprocess.Popen:
+    """Start a job subprocess in its OWN session (and so its own
+    process group): feeder pools and service grandchildren it spawns
+    share the group, and ``kill_process_tree`` can reap the whole tree
+    instead of orphaning them past the parent's death."""
+    return subprocess.Popen(
+        cmd, env=env, cwd=cwd, stdout=stdout, stderr=stderr,
+        text=True, start_new_session=True)
+
+
+def kill_process_tree(proc: subprocess.Popen,
+                      sig: int = signal.SIGTERM,
+                      grace_s: float = 5.0,
+                      escalate: bool = True) -> None:
+    """Signal the job's whole process group; with ``escalate`` (the
+    timeout path), SIGKILL the group after ``grace_s`` if the leader is
+    still alive.  ``escalate=False`` sends the one signal and returns —
+    the fleet's graceful-preempt path, where the in-job handler needs
+    its grace window to write the emergency checkpoint and the
+    *supervisor* owns the escalation deadline.  Safe on an already-dead
+    process, and falls back to the single process when the child shares
+    our group (a caller that bypassed ``launch_one``)."""
+    try:
+        pgid = os.getpgid(proc.pid)
+    except (ProcessLookupError, OSError):
+        pgid = None
+    own_group = False
+    try:
+        own_group = pgid is not None and pgid != os.getpgid(0)
+    except OSError:
+        pass
+
+    def _send(s: int) -> None:
+        try:
+            if own_group:
+                os.killpg(pgid, s)
+            else:
+                proc.send_signal(s)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+    def _group_alive() -> bool:
+        # ANY surviving member counts — the leader exiting while a
+        # SIGTERM-ignoring grandchild lives is exactly the orphan (and
+        # held-open pipe) this escalation exists to reap
+        if own_group:
+            try:
+                os.killpg(pgid, 0)
+                return True
+            except (ProcessLookupError, PermissionError, OSError):
+                return False
+        return proc.poll() is None
+
+    _send(sig)
+    if sig == signal.SIGKILL or not escalate:
+        return
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        proc.poll()             # reap the leader so its pgid can empty
+        if not _group_alive():
+            return
+        time.sleep(0.05)
+    if _group_alive():
+        _send(signal.SIGKILL)
 
 
 def parse_stdout_metrics(out: str) -> dict:
@@ -105,35 +208,40 @@ def run_one(
     if metrics_dir is not None:
         os.makedirs(metrics_dir, exist_ok=True)
         flags.append(f"--metrics_dir={metrics_dir}")
-    cmd = [
-        sys.executable, "-m", "tpu_hc_bench", "1", "0", str(batch), "ici",
-        f"--model={model}",
-        f"--num_warmup_batches={warmup}", f"--num_batches={batches}",
-    ]
-    if use_fp16:
-        cmd.append("--use_fp16=True")
-    cmd.extend(flags)
+    cmd = build_cmd(model, batch, flags, warmup=warmup, batches=batches,
+                    use_fp16=use_fp16)
 
     rec: dict = {"model": model, "batch_size": batch}
     if flags:
         rec["flags"] = flags
     t0 = time.time()
+    proc = launch_one(cmd, env=env, cwd=cwd, stdout=subprocess.PIPE,
+                      stderr=subprocess.PIPE)
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout_s, env=env, cwd=cwd)
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        # reap the WHOLE process group: a timed-out job's feeder pools /
+        # service grandchildren must not outlive it (they would starve
+        # every later measurement of host CPUs)
+        kill_process_tree(proc)
+        try:
+            # drain pipes; bounded — an unkillable (D-state) survivor
+            # holding the pipe must not wedge the whole search
+            proc.communicate(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            pass
         rec.update(wall_s=round(time.time() - t0, 1), error="timeout",
                    exit_class="timeout")
         return rec
-    out = proc.stdout + proc.stderr
+    out = stdout + stderr
     rec["wall_s"] = round(time.time() - t0, 1)
     rec["returncode"] = proc.returncode
     if proc.returncode != 0:
-        cls = EXIT_CLASSES.get(proc.returncode)
-        rec["exit_class"] = cls or f"exit-{proc.returncode}"
-        rec["error"] = (cls or
-                        (out.strip().splitlines()[-1] if out.strip()
-                         else "?"))
+        cls = classify_exit(proc.returncode)
+        rec["exit_class"] = cls
+        rec["error"] = (EXIT_CLASSES.get(proc.returncode)
+                        or (out.strip().splitlines()[-1] if out.strip()
+                            else "?"))
         return rec
     rec.update(parse_stdout_metrics(out))
     if metrics_dir is not None:
