@@ -106,6 +106,11 @@ def _serve_main() -> int:
             "quant": summary.get("quant"),
             "aot_decode_temp_bytes": summary.get("aot_decode_temp_bytes"),
             "post_warmup_compiles": summary["post_warmup_compiles"],
+            # round 20: the attribution-shift metrics obs regress gates
+            # on (absent on pre-r20 history; the checks skip there)
+            "tail_queue_wait_frac": summary.get("tail_queue_wait_frac"),
+            "tail_decode_stall_frac": summary.get(
+                "tail_decode_stall_frac"),
             "config_source": cfg.config_source,
             "tuned_config": cfg.tuned_config,
         },

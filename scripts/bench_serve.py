@@ -112,15 +112,23 @@ def run_ab(args) -> dict:
             "p99_e2e_ms": summary["p99_e2e_ms"],
             "p99_ttft_ms": summary["p99_ttft_ms"],
             "p50_e2e_ms": summary["p50_e2e_ms"],
+            "p99_queue_ms": summary.get("p99_queue_ms"),
             "goodput": summary["goodput"],
             "queue_depth_max": summary["queue_depth_max"],
             "wall_s": summary["wall_s"],
             "completed": summary["completed"],
             "post_warmup_compiles": summary["post_warmup_compiles"],
+            # round 20: the tail-attribution fold (obs.requests) — the
+            # A/B's WHY column: static's p99 lives in queue_wait/
+            # decode_stall, continuous moves it back to decode_active
+            "attribution": summary.get("attribution"),
             "metrics_dir": mdir,
         }
 
+    from tpu_hc_bench.obs import requests as requests_mod
+
     st, ct = arms["static"], arms["continuous"]
+    st_attr, ct_attr = st["attribution"], ct["attribution"]
     verdict = {
         # the two acceptance properties: continuous beats static on the
         # p99 tail AND on goodput-under-load, at the same offered load
@@ -135,6 +143,15 @@ def run_ab(args) -> dict:
         "zero_post_warmup_compiles": (
             ct["post_warmup_compiles"] == 0
             and st["post_warmup_compiles"] == 0),
+        # the attribution story: continuous batching's tail spends a
+        # smaller share of its e2e waiting (queue + resident-starved)
+        # than static's, at the same offered load
+        "continuous_tail_waits_less": (
+            (ct_attr["tail_frac"]["queue_wait"]
+             + ct_attr["tail_frac"]["decode_stall"])
+            < (st_attr["tail_frac"]["queue_wait"]
+               + st_attr["tail_frac"]["decode_stall"])
+            if st_attr and ct_attr else None),
         "compile_cache": engine.cache_dir,
         "compile_record": engine.compile_record,
     }
@@ -167,6 +184,14 @@ def run_ab(args) -> dict:
             "p99_ms": ct["p99_e2e_ms"],
             "goodput": ct["goodput"],
             "tokens_per_s": ct["tokens_per_s"],
+            # the regress gate's attribution-shift metrics (headline =
+            # continuous arm, matching the other extras)
+            **requests_mod.flatten_attribution(ct_attr),
+            # the static-vs-continuous attribution delta as `obs diff`
+            # renders it (also viewable live: obs diff <root>/static
+            # <root>/continuous)
+            "attribution_diff": requests_mod.attribution_diff_lines(
+                st_attr, ct_attr),
             "arms": arms,
             "verdict": verdict,
         },
@@ -221,6 +246,7 @@ def run_decode_ab(args) -> dict:
             "completed": summary["completed"],
             "aot_decode_temp_bytes": summary["aot_decode_temp_bytes"],
             "post_warmup_compiles": summary["post_warmup_compiles"],
+            "attribution": summary.get("attribution"),
             "metrics_dir": mdir,
         }
         wk, wma = engine.aot_memory_worst(kinds=("decode",))

@@ -113,6 +113,86 @@ def ceiling_file(tmp_path) -> str:
     return str(p)
 
 
+# --- serving-lane session fixtures (rounds 16-20) ---------------------
+# ONE warmed engine per family, shared by test_serve AND
+# test_requests_obs — engine warmup is the serving lane's whole test
+# cost, so every closed loop below rides these in VIRTUAL time.
+
+SERVE_VCOSTS = {"prefill": 0.004, "decode": 0.003, "classify": 0.002}
+
+
+def _serve_quiet(_msg):
+    pass
+
+
+@pytest.fixture(scope="session")
+def serve_cfg():
+    from tpu_hc_bench import flags
+
+    return flags.BenchmarkConfig(
+        model="moe_tiny", workload="serve",
+        arrival_rate=50.0, num_requests=8,
+        max_prompt_len=8, max_output_len=4,
+        max_in_flight=2, kv_page_size=4, seed=0,
+    ).resolve()
+
+
+@pytest.fixture(scope="session")
+def moe_engine(serve_cfg):
+    from tpu_hc_bench.serve import engine as engine_mod
+
+    return engine_mod.ServeEngine(serve_cfg, print_fn=_serve_quiet)
+
+
+@pytest.fixture(scope="session")
+def moe_requests(serve_cfg, moe_engine):
+    from tpu_hc_bench.serve import arrivals
+
+    return arrivals.build_requests(serve_cfg, moe_engine.spec.vocab_size)
+
+
+@pytest.fixture(scope="session")
+def moe_ab(tmp_path_factory, moe_engine, moe_requests):
+    """BOTH scheduler arms over the same trace and warmed engine, each
+    leaving a real metrics dir — the serving lane's only closed-loop
+    runs in the default lane."""
+    from tpu_hc_bench.obs import metrics as obs_metrics
+    from tpu_hc_bench.serve import engine as engine_mod
+
+    root = tmp_path_factory.mktemp("serve_ab")
+    out = {}
+    for arm in ("static", "continuous"):
+        mdir = str(root / arm)
+        writer = obs_metrics.MetricsWriter(
+            mdir, obs_metrics.run_manifest(
+                cfg=moe_engine.cfg, extra={"workload": "serve"}))
+        try:
+            summary = moe_engine.run(
+                moe_requests, batching=arm, writer=writer,
+                clock=engine_mod.VirtualClock(SERVE_VCOSTS))
+        finally:
+            writer.close()
+        out[arm] = {"summary": summary, "mdir": mdir}
+    return out
+
+
+@pytest.fixture(scope="session")
+def trivial_engine():
+    from tpu_hc_bench import flags
+    from tpu_hc_bench.serve import engine as engine_mod
+
+    cfg = flags.BenchmarkConfig(
+        model="trivial", workload="serve",
+        arrival_rate=100.0, num_requests=6, max_in_flight=2,
+        # regression pin: classify members allocate no KV pool, so an
+        # explicit --kv_pages below one request's worst case must not
+        # crash their construction (it used to trip the decode-lane
+        # pool validation)
+        kv_pages=2,
+    ).resolve()
+    return engine_mod.ServeEngine(cfg, print_fn=_serve_quiet)
+
+
 @pytest.fixture(scope="session")
 def rewind_run(tmp_path_factory):
     """ONE tiny driver run with an injected rewind fault, shared by

@@ -47,69 +47,15 @@ from tpu_hc_bench.serve import arrivals, slo
 from tpu_hc_bench.serve import engine as engine_mod
 from tpu_hc_bench.tune import prune, registry, space
 
-VCOSTS = {"prefill": 0.004, "decode": 0.003, "classify": 0.002}
+# the session engine fixtures (serve_cfg/moe_engine/moe_requests/
+# moe_ab/trivial_engine) live in conftest.py since round 20 — shared
+# with test_requests_obs; the shared cost table keeps this module's
+# VirtualClock replays deterministic against the moe_ab fixture runs
+from conftest import SERVE_VCOSTS as VCOSTS  # noqa: E402
 
 
 def _quiet(_msg):
     pass
-
-
-# --- session fixtures: the one warmed engine per family ---------------
-
-
-@pytest.fixture(scope="session")
-def serve_cfg():
-    return flags.BenchmarkConfig(
-        model="moe_tiny", workload="serve",
-        arrival_rate=50.0, num_requests=8,
-        max_prompt_len=8, max_output_len=4,
-        max_in_flight=2, kv_page_size=4, seed=0,
-    ).resolve()
-
-
-@pytest.fixture(scope="session")
-def moe_engine(serve_cfg):
-    return engine_mod.ServeEngine(serve_cfg, print_fn=_quiet)
-
-
-@pytest.fixture(scope="session")
-def moe_requests(serve_cfg, moe_engine):
-    return arrivals.build_requests(serve_cfg, moe_engine.spec.vocab_size)
-
-
-@pytest.fixture(scope="session")
-def moe_ab(tmp_path_factory, moe_engine, moe_requests):
-    """BOTH scheduler arms over the same trace and warmed engine, each
-    leaving a real metrics dir — the module's only closed-loop runs."""
-    root = tmp_path_factory.mktemp("serve_ab")
-    out = {}
-    for arm in ("static", "continuous"):
-        mdir = str(root / arm)
-        writer = obs_metrics.MetricsWriter(
-            mdir, obs_metrics.run_manifest(
-                cfg=moe_engine.cfg, extra={"workload": "serve"}))
-        try:
-            summary = moe_engine.run(
-                moe_requests, batching=arm, writer=writer,
-                clock=engine_mod.VirtualClock(VCOSTS))
-        finally:
-            writer.close()
-        out[arm] = {"summary": summary, "mdir": mdir}
-    return out
-
-
-@pytest.fixture(scope="session")
-def trivial_engine():
-    cfg = flags.BenchmarkConfig(
-        model="trivial", workload="serve",
-        arrival_rate=100.0, num_requests=6, max_in_flight=2,
-        # regression pin: classify members allocate no KV pool, so an
-        # explicit --kv_pages below one request's worst case must not
-        # crash their construction (it used to trip the decode-lane
-        # pool validation)
-        kv_pages=2,
-    ).resolve()
-    return engine_mod.ServeEngine(cfg, print_fn=_quiet)
 
 
 # --- arrivals ---------------------------------------------------------

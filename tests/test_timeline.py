@@ -191,6 +191,56 @@ def test_merge_without_spans_raises(tmp_path):
         tl.merge_chrome_trace(str(tmp_path))
 
 
+def test_merge_mixed_aligned_and_clockless_rank_is_loud(tmp_path):
+    """Round-20 fallback hardening: a rank whose spans file has no
+    ``clock`` records AND whose dir has no heartbeats merges with the
+    identity offset and ONE loud warning — it is never silently
+    dropped, and the aligned ranks stay aligned."""
+    d = str(tmp_path)
+    wall = 1.7e9
+    _write_spans(d, 0, [{"name": "step_dispatch", "t0": 1000.5,
+                         "t1": 1000.6}])
+    _write_spans(d, 1, [{"name": "step_dispatch", "t0": 5000.5,
+                         "t1": 5000.6}])
+    # rank 2: NO clock record in its spans file, NO heartbeat file
+    _write_spans(d, 2, [{"name": "ring_get", "t0": 77.0, "t1": 78.0}])
+    _write_heartbeats(d, 0, [(1000.0, wall)])
+    _write_heartbeats(d, 1, [(5000.0, wall)])
+    trace = tl.merge_chrome_trace(d)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert sorted({e["pid"] for e in xs}) == [0, 1, 2]   # nobody dropped
+    a = {e["pid"]: e["ts"] for e in xs}
+    assert a[0] == a[1]                 # aligned pair still aligned
+    assert trace["metadata"]["aligned_ranks"] == [0, 1]
+    warns = trace["metadata"]["warnings"]
+    assert len(warns) == 1 and "rank2" in warns[0]
+    assert "IDENTITY offset" in warns[0]
+    # the clockless rank's process lane is marked in the trace itself
+    marks = [e for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["pid"] == 2]
+    assert any("unaligned clock" in e["args"]["name"] for e in marks)
+    # the CLI surfaces it: WARNING on stderr, degraded exit code 1
+    import io as _io
+
+    from tpu_hc_bench.obs.__main__ import main as obs_main_fn
+
+    buf = _io.StringIO()
+    import contextlib
+    import sys as _sys
+
+    err = _io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = obs_main_fn(["timeline", d], out=buf)
+    assert rc == 1
+    assert "WARNING" in err.getvalue() and "rank2" in err.getvalue()
+    # all-aligned dirs keep exiting 0 (pin for the existing contract)
+    for f in os.listdir(d):
+        if f.startswith("spans.2."):
+            os.unlink(os.path.join(d, f))
+    with contextlib.redirect_stderr(_io.StringIO()):
+        assert obs_main_fn(["timeline", d], out=_io.StringIO()) == 0
+
+
 def test_alignment_survives_a_rebooted_incarnation(tmp_path):
     """Elastic resume on a REBOOTED host restarts CLOCK_MONOTONIC: one
     rank's spans file then carries two lives with wildly different

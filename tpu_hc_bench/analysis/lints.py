@@ -56,6 +56,11 @@ shipped) are checked statically:
   timestamp into the compiled program and the span lies in every
   execution after the first.  Recorder calls wrap the *dispatch* of
   compiled work (the driver/serve-engine idiom), never live inside it.
+- **span-name-registry** (warning): a literal span name at a
+  ``timeline.span``/``record_span``/``instant`` call site that is not
+  registered in ``obs.timeline.KNOWN_SPANS``.  Folds key on span names,
+  so a typo'd name records fine and silently vanishes from every
+  timeline consumer; the registry makes the typo a CI finding.
 - **fleet-blocking-wait** (error): a no-timeout ``.wait()``/``.join()``
   inside a loop body under ``tpu_hc_bench/fleet/`` — the fleet control
   loop is one thread supervising N jobs, and an unbounded block on any
@@ -104,9 +109,10 @@ SERVE_RECOMPILE = "serve-bucket-recompile"
 SPAN_IN_JIT = "span-in-compiled-fn"
 DEQUANT_HOT = "dequantize-in-hot-loop"
 FLEET_WAIT = "fleet-blocking-wait"
+SPAN_REGISTRY = "span-name-registry"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
                     INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE, SPAN_IN_JIT,
-                    DEQUANT_HOT, FLEET_WAIT)
+                    DEQUANT_HOT, FLEET_WAIT, SPAN_REGISTRY)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -807,6 +813,59 @@ class _FileLinter:
                 "every execution; record around the jitted call, not "
                 "inside it (obs.timeline is host-side by contract)")
 
+    # -- span-name-registry --------------------------------------------
+
+    _SPAN_NAME_CALLEES = {"record_span", "instant", "span"}
+
+    def _check_span_name_registry(self):
+        """**span-name-registry** (warning): a literal span name passed
+        to ``timeline.span``/``record_span``/``instant`` that is not in
+        ``obs.timeline.KNOWN_SPANS``.
+
+        Every fold keys on span names (``timeline_lines`` totals, the
+        heartbeat phase column, the Chrome-trace lanes) — a typo'd name
+        records fine and then silently vanishes from every consumer,
+        which is the worst failure mode telemetry can have.  The
+        registry is one frozenset in ``obs.timeline``; adding a span is
+        a one-line registration there.  Variable names (the engine's
+        ``record_span(kind, ...)``) are skipped — the lint is for
+        literals, where the typo class lives.
+        """
+        try:
+            from tpu_hc_bench.obs.timeline import KNOWN_SPANS
+        except Exception:        # analysis must run without obs too
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            base = name.rsplit(".", 1)[-1]
+            if base not in self._SPAN_NAME_CALLEES:
+                continue
+            timeline_owned = (
+                any(h in name.lower() for h in self._SPAN_MODULE_HINTS)
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in self._timeline_imported_names))
+            if not timeline_owned and base != "record_span":
+                continue    # a generic .instant()/.span() that is not
+                            # the flight recorder's
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue    # variable span names are the caller's
+                            # contract, not a typo class
+            if arg.value in KNOWN_SPANS:
+                continue
+            self._emit(
+                SPAN_REGISTRY, "warning", node,
+                f"span name {arg.value!r} at `{name or base}(...)` is "
+                f"not in obs.timeline.KNOWN_SPANS — an unregistered "
+                f"(or typo'd) name records fine and then silently "
+                f"vanishes from every timeline fold; register it in "
+                f"KNOWN_SPANS or fix the spelling")
+
     # -- fleet-blocking-wait -------------------------------------------
 
     # no-arg blocking callees: `.wait()` (Popen, Event, Condition) and
@@ -920,6 +979,7 @@ class _FileLinter:
         self._check_dequant_hot_loop()
         self._check_serve_recompile()
         self._check_fleet_blocking_wait()
+        self._check_span_name_registry()
         return self.findings
 
 

@@ -93,7 +93,7 @@ SERVE_ONLY_FLAGS = (
     "arrival", "arrival_rate", "num_requests", "serve_buckets",
     "max_in_flight", "kv_page_size", "kv_pages", "max_prompt_len",
     "max_output_len", "batching", "decode_attention", "quant",
-    "decode_block_pages",
+    "decode_block_pages", "slo_e2e_ms",
 )
 
 
@@ -582,6 +582,13 @@ class BenchmarkConfig:
                                               # step (0 = auto: 1 page, the
                                               # page IS the block; tuned
                                               # like any other lever)
+    slo_e2e_ms: float = 0.0                   # per-request e2e SLO target
+                                              # (round 20): windowed
+                                              # violation/burn-rate
+                                              # tracking in the serve
+                                              # summary distinguishes
+                                              # sustained overload from a
+                                              # transient burst (0 = off)
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -685,6 +692,10 @@ class BenchmarkConfig:
                 "--decode_block_pages sizes the paged kernel's page "
                 "blocks; it has no meaning under "
                 "--decode_attention=gather")
+        if self.slo_e2e_ms < 0:
+            raise ValueError(
+                f"--slo_e2e_ms must be >= 0 ms (0 = no SLO tracking): "
+                f"{self.slo_e2e_ms}")
         # loud format checks (raise on malformed spec; values re-read by
         # the engine)
         parse_serve_buckets(self.serve_buckets, self.max_in_flight)
@@ -1296,6 +1307,7 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["off", "int8_w", "int8_kv"])
     p.add_argument("--decode_block_pages", type=int,
                    default=d.decode_block_pages)
+    p.add_argument("--slo_e2e_ms", type=float, default=d.slo_e2e_ms)
     return p
 
 

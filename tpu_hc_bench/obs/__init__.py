@@ -41,6 +41,14 @@ Where ``analysis`` inspects the *compiled program* (HLO, jaxpr),
 - ``obs.regress`` — the noise-aware regression gate: a fresh BENCH
   record vs the median/MAD of the matching-config-fingerprint history,
   direction-aware per metric (throughput down, p99/HBM up).
+- ``obs.requests`` — the per-request lifecycle ledger (serving lane):
+  every request's e2e decomposed into conserved components
+  (queue_wait / prefill / decode_active / decode_stall /
+  retire_overhead) stamped by the engine, the slowest-decile tail
+  attribution (``summarize`` names where the p99 lives, ``diff``
+  renders component deltas, ``regress`` gates on attribution shift),
+  per-bucket occupancy folds, and per-request Chrome-trace lanes
+  merged into the ``timeline`` view.
 - ``python -m tpu_hc_bench.obs`` — ``summarize`` renders either
   artifact kind (a metrics run or a raw trace directory); ``diff``
   compares two runs at bucket/metric granularity, so a regression

@@ -174,14 +174,27 @@ def main(argv: list[str] | None = None, out=None) -> int:
         if args.cmd == "timeline":
             from tpu_hc_bench.obs import timeline as timeline_mod
 
-            path = timeline_mod.write_chrome_trace(args.run_dir,
-                                                   out_path=args.out)
+            trace = timeline_mod.merge_chrome_trace(args.run_dir)
+            path = timeline_mod.write_trace_json(
+                trace, args.out or os.path.join(
+                    args.run_dir, "timeline.trace.json"))
+            # clock-fallback ranks merge with identity offset but must
+            # be LOUD (the degraded-run-dir contract: rendered
+            # survivors + WARNING on stderr + exit 1)
+            warnings = trace["metadata"].get("warnings", [])
+            for w in warnings:
+                print(f"WARNING: {w}", file=sys.stderr)
             for ln in timeline_mod.timeline_lines(args.run_dir):
                 print(ln.strip(), file=out)
+            lanes = trace["metadata"].get("request_lanes", 0)
+            if lanes:
+                print(f"request lanes: {lanes} request(s) rendered as "
+                      f"their own timeline rows (pid 'requests')",
+                      file=out)
             print(f"chrome trace written: {path} (open in "
                   f"chrome://tracing or https://ui.perfetto.dev)",
                   file=out)
-            return 0
+            return 1 if warnings else 0
         if args.cmd == "regress":
             from tpu_hc_bench.obs import regress as regress_mod
 

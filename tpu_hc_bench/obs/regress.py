@@ -52,6 +52,14 @@ CHECKS = (
     # pool chip-seconds under churn; a drop means the scheduler started
     # wasting the pool (thrash, slow readmission, orphaned capacity)
     (("extra", "fleet_goodput"), "higher", "fleet goodput"),
+    # round 20: attribution shift — the slowest decile's e2e share
+    # spent waiting (admission queue / resident-but-starved).  A rise
+    # means the tail moved from compute to waiting even if p99 itself
+    # sits inside the noise band; pre-r20 serve history simply lacks
+    # the fields and the checks skip (never KeyError)
+    (("extra", "tail_queue_wait_frac"), "lower", "tail queue_wait frac"),
+    (("extra", "tail_decode_stall_frac"), "lower",
+     "tail decode_stall frac"),
 )
 
 #: identity fields folded into the fingerprint (record path order)
@@ -76,6 +84,16 @@ _FINGERPRINT_DEFAULTS = {
 
 DEFAULT_MAD_K = 4.0
 DEFAULT_REL_FLOOR = 0.03
+
+#: absolute noise floors by metric label.  The relative floor protects
+#: quiet histories only when the median is nonzero — a FRACTION metric
+#: (round 20's attribution shares) legitimately sits at exactly 0.0 in
+#: a well-provisioned config's history, where rel_floor*|0| = 0 would
+#: flag any positive jitter; 5pp is the smallest shift worth a human.
+ABS_FLOORS = {
+    "tail queue_wait frac": 0.05,
+    "tail decode_stall frac": 0.05,
+}
 
 
 def _get(rec: dict, path: tuple[str, ...]):
@@ -182,7 +200,8 @@ def regress_check(fresh: dict, history: list[dict],
             continue
         med = statistics.median(hist)
         sigma = 1.4826 * statistics.median(abs(x - med) for x in hist)
-        threshold = max(mad_k * sigma, rel_floor * abs(med))
+        threshold = max(mad_k * sigma, rel_floor * abs(med),
+                        ABS_FLOORS.get(label, 0.0))
         worse = (med - float(v)) if direction == "higher" \
             else (float(v) - med)
         entry = {"metric": label, "value": float(v), "median": med,

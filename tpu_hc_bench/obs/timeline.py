@@ -59,6 +59,23 @@ _PHASE_LANE_NAMES = frozenset((
     "checkpoint_async", "rewind_replay", "emergency_save", "idle", "end",
 ))
 
+#: the span-name registry (round 20): every literal name the
+#: instrumented lanes record.  A typo'd name silently vanishes from
+#: every fold — the ``span-name-registry`` analysis lint checks literal
+#: names at ``timeline.span``/``record_span``/``instant`` call sites
+#: against this set, so a new span name is a one-line registration
+#: here, not an unfindable hole in the timeline.
+KNOWN_SPANS = frozenset((
+    # train driver
+    "input_wait", "step_dispatch", "device_step", "eval_dispatch",
+    # data service
+    "svc_decode", "ring_put", "ring_get",
+    # serve engine
+    "prefill", "decode", "classify", "admit", "retire",
+    # checkpoint
+    "ckpt_snapshot", "ckpt_write", "ckpt_restore",
+)) | _PHASE_LANE_NAMES
+
 
 def _to_record(item: tuple) -> dict:
     """Ring tuple -> the ONE on-disk/dump record shape (flush and
@@ -476,7 +493,16 @@ def merge_chrome_trace(run_dir: str) -> dict:
     """Merge every rank's spans into one aligned Chrome-trace JSON
     (``chrome://tracing`` / Perfetto ``traceEvents`` format): one pid
     per rank, one tid per recording thread, timestamps aligned through
-    the heartbeat clock pairs and rebased to the earliest span.
+    the heartbeat clock pairs and rebased to the earliest span.  A rank
+    with NO clock source anywhere (no heartbeats, no spans-file
+    ``clock`` records) still merges — identity offset, a loud entry in
+    ``metadata["warnings"]``, and a marked process name — instead of
+    silently landing hours off or being dropped.
+
+    Serving runs (round 20): the run dir's ``metrics.jsonl`` request
+    records additionally render as per-request lanes
+    (``obs.requests.request_trace_events``) beside the rank spans, so a
+    single slow request is traceable through the engine.
 
     Raises FileNotFoundError when the run dir has no spans files."""
     per_rank = read_spans(run_dir)
@@ -486,6 +512,12 @@ def merge_chrome_trace(run_dir: str) -> dict:
             f"--flight_recorder off, or --metrics_dir unset?")
     clocks = rank_clocks(run_dir)
     offsets = {rank: c.median_offset for rank, c in clocks.items()}
+    warnings = [
+        f"rank{rank}: no clock records in its spans file and no "
+        f"heartbeats in {run_dir} — merged with IDENTITY offset "
+        f"(timestamps are raw monotonic; cross-rank alignment for "
+        f"this rank is meaningless)"
+        for rank in sorted(per_rank) if rank not in clocks]
     aligned: list[tuple[int, dict, float]] = []
     for rank, spans in per_rank.items():
         clock = clocks.get(rank)
@@ -493,7 +525,16 @@ def merge_chrome_trace(run_dir: str) -> dict:
             t0 = float(s["t0"])
             aligned.append(
                 (rank, s, t0 + (clock.offset_at(t0) if clock else 0.0)))
+    # per-request lanes from the metrics stream (serving runs; a
+    # training run simply has no request records here)
+    from tpu_hc_bench.obs import requests as requests_mod
+
+    req_events = requests_mod.request_trace_events(
+        _metrics_records(run_dir))
     t_base = min(t for _, _, t in aligned)
+    if req_events:
+        t_base = min(t_base, min(e["ts_unix"] for e in req_events
+                                 if "ts_unix" in e))
     events = []
     for rank, s, t0 in aligned:
         dur_us = max(0.0, (float(s["t1"]) - float(s["t0"])) * 1e6)
@@ -506,6 +547,10 @@ def merge_chrome_trace(run_dir: str) -> dict:
         if args:
             ev["args"] = args
         events.append(ev)
+    for ev in req_events:
+        if "ts_unix" in ev:
+            ev["ts"] = round((ev.pop("ts_unix") - t_base) * 1e6, 1)
+        events.append(ev)
     for rank in per_rank:
         events.append({"name": "process_name", "ph": "M", "pid": rank,
                        "args": {"name": f"rank{rank}"
@@ -515,18 +560,36 @@ def merge_chrome_trace(run_dir: str) -> dict:
             "metadata": {"run_dir": run_dir,
                          "ranks": sorted(per_rank),
                          "aligned_ranks": sorted(offsets),
+                         "warnings": warnings,
+                         "request_lanes": sum(
+                             1 for e in req_events
+                             if e.get("name") == "queue_wait"),
                          "t_base_unix": t_base}}
 
 
-def write_chrome_trace(run_dir: str, out_path: str | None = None) -> str:
-    trace = merge_chrome_trace(run_dir)
-    out_path = out_path or os.path.join(run_dir, "timeline.trace.json")
+def _metrics_records(run_dir: str) -> list[dict]:
+    """Tolerant read of the run dir's metrics stream (the request-lane
+    source); missing/corrupt files are an empty list, never an error —
+    spans dirs without a metrics stream are normal."""
+    from tpu_hc_bench.obs import metrics as metrics_mod
+
+    return metrics_mod.read_jsonl(
+        os.path.join(run_dir, metrics_mod.METRICS_NAME))
+
+
+def write_trace_json(trace: dict, out_path: str) -> str:
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(trace, f, default=str)
         f.write("\n")
     os.replace(tmp, out_path)
     return out_path
+
+
+def write_chrome_trace(run_dir: str, out_path: str | None = None) -> str:
+    trace = merge_chrome_trace(run_dir)
+    out_path = out_path or os.path.join(run_dir, "timeline.trace.json")
+    return write_trace_json(trace, out_path)
 
 
 # ---------------------------------------------------------------------

@@ -46,6 +46,7 @@ import numpy as np
 from tpu_hc_bench.flags import BenchmarkConfig, parse_serve_buckets
 from tpu_hc_bench.obs import efficiency as obs_efficiency
 from tpu_hc_bench.obs import metrics as obs_metrics
+from tpu_hc_bench.obs import requests as requests_mod
 from tpu_hc_bench.obs import timeline as timeline_mod
 from tpu_hc_bench.serve import slo as slo_mod
 from tpu_hc_bench.serve.arrivals import Request
@@ -146,6 +147,12 @@ class _InFlight:
     t_admit: float = 0.0
     t_first: float | None = None
     out_tokens: list[int] = dataclasses.field(default_factory=list)
+    # request-attribution bookkeeping (round 20, obs.requests): summed
+    # wall of the decode/classify steps this request was resident for,
+    # and the end instant of its last such step — two float stores per
+    # resident per step, well under the round-17 recorder guard
+    active_s: float = 0.0
+    t_last: float | None = None
 
 
 class ServeEngine:
@@ -476,18 +483,31 @@ class ServeEngine:
         tokens_out = 0
         productive_s = 0.0
         queue_depths: list[int] = []
+        # per-(kind,bucket) utilization: key -> [steps, rows, active
+        # rows, wall s] — the occupancy heatmap's raw counts
+        butil: dict[str, list] = {}
         t0 = clock.now()
         last_record_step = 0
+        # the request-lane timeline anchor: engine-relative instants
+        # (arrival_s et al.) placed on the wall by `obs timeline`
+        writer.event("serve_clock", t_unix=time.time(),
+                     t_mono=time.monotonic(), batching=batching)
 
         def now() -> float:
             return clock.now() - t0
+
+        def bucket_acct(kind: str, bucket: int, active_rows: int,
+                        dt: float) -> None:
+            u = butil.setdefault(f"{kind}@{bucket}", [0, 0, 0, 0.0])
+            u[0] += 1
+            u[1] += bucket
+            u[2] += active_rows
+            u[3] += dt
 
         def finish(fl: _InFlight, t_done: float) -> None:
             rec = {
                 "id": fl.req.rid,
                 "arrival_s": round(fl.req.arrival_s, 6),
-                "queue_ms": round(
-                    1e3 * (fl.t_admit - fl.req.arrival_s), 3),
                 "ttft_ms": round(
                     1e3 * ((fl.t_first if fl.t_first is not None
                             else t_done) - fl.req.arrival_s), 3),
@@ -495,6 +515,15 @@ class ServeEngine:
                 "prompt_len": fl.req.prompt_len,
                 "output_len": fl.produced,
             }
+            # the conserved e2e decomposition (obs.requests): classify
+            # members have no prompt pass, so their whole resident
+            # window belongs to the decode lane (t_first := t_admit)
+            rec.update(requests_mod.components_ms(
+                fl.req.arrival_s, fl.t_admit,
+                (fl.t_first if self.decode_mode and fl.t_first is not None
+                 else fl.t_admit),
+                fl.t_last if fl.t_last is not None else t_done,
+                t_done, fl.active_s))
             if self.decode_mode:
                 # the greedy token ids (synthetic anyway) — the decode
                 # parity tests and postmortems read them; <= 32 ints
@@ -533,6 +562,7 @@ class ServeEngine:
             steps["prefill"] += 1
             tokens_out += 1
             productive_s += dt * (req.prompt_len / s)
+            bucket_acct("prefill", s, req.prompt_len, dt)
             fl = _InFlight(req=req, pages=pages, table=table,
                            length=req.prompt_len, produced=1,
                            last_token=int(next_tok[0]), t_admit=t_admit,
@@ -562,6 +592,7 @@ class ServeEngine:
             steps["decode"] += 1
             tokens_out += len(active)
             productive_s += dt * (len(active) / b)
+            bucket_acct("decode", b, len(active), dt)
             next_toks = np.asarray(next_toks)
             t_done = now()
             still: list[_InFlight] = []
@@ -570,6 +601,8 @@ class ServeEngine:
                 fl.out_tokens.append(fl.last_token)
                 fl.length += 1
                 fl.produced += 1
+                fl.active_s += dt
+                fl.t_last = t_done
                 if fl.produced >= fl.req.output_len:
                     finish(fl, t_done)
                 else:
@@ -588,10 +621,13 @@ class ServeEngine:
             steps["classify"] += 1
             tokens_out += len(active)
             productive_s += dt * (len(active) / b)
+            bucket_acct("classify", b, len(active), dt)
             t_done = now()
             for fl in active:
                 fl.t_first = t_done
                 fl.produced = 1
+                fl.active_s += dt
+                fl.t_last = t_done
                 finish(fl, t_done)
             active.clear()
 
@@ -641,6 +677,10 @@ class ServeEngine:
                     free_pages=(allocator.free_pages
                                 if allocator else None),
                     tokens=tokens_out,
+                    # running per-bucket occupancy — `obs watch`'s live
+                    # utilization column
+                    bucket_occ={k: round(u[2] / u[1], 3)
+                                for k, u in butil.items() if u[1]},
                     **{f"{k}_steps": v for k, v in steps.items()})
 
         if self.decode_mode:
@@ -648,6 +688,7 @@ class ServeEngine:
         wall = max(now(), 1e-9)
         entries_final = self._count_cache()
         fold = slo_mod.fold_requests(done)
+        attribution = requests_mod.fold_attribution(done)
         summary = {
             "workload": "serve",
             "model": self.cfg.model,
@@ -676,9 +717,23 @@ class ServeEngine:
                 "aot_decode_temp_bytes"),
             "post_warmup_compiles": entries_final
                                     - self.entries_after_warmup,
+            # round 20 (obs.requests): the tail-attribution fold, its
+            # regress projection, and the per-bucket occupancy account
+            "attribution": attribution,
+            **requests_mod.flatten_attribution(attribution),
+            "bucket_util": {
+                k: {"steps": u[0], "rows": u[1], "active_rows": u[2],
+                    "wall_s": round(u[3], 4),
+                    "occupancy": round(u[2] / u[1], 4) if u[1] else 0.0}
+                for k, u in butil.items()},
             **{f"{k}_steps": v for k, v in steps.items()},
             **fold,
         }
+        if self.cfg.slo_e2e_ms:
+            # windowed SLO burn rate: sustained overload vs transient
+            # burst, against the --slo_e2e_ms e2e target
+            summary["slo"] = slo_mod.fold_burn_rate(
+                done, self.cfg.slo_e2e_ms)
         writer.event("serve_summary", **summary)
         writer.event("serve_compile", **self.compile_record,
                      entries_final=entries_final,

@@ -27,6 +27,8 @@ goodput/MFU/p50 account):
 
 from __future__ import annotations
 
+from tpu_hc_bench.obs import requests as requests_mod
+
 SERVE_SUMMARY_KIND = "serve_summary"
 REQUEST_KIND = "request"
 
@@ -35,6 +37,9 @@ DIFF_METRICS = (
     ("p99 ttft ms", "p99_ttft_ms"),
     ("p99 e2e ms", "p99_e2e_ms"),
     ("p50 e2e ms", "p50_e2e_ms"),
+    # round 20: queue wait is the cheapest leading overload indicator
+    # and has been on every request record since the lane opened
+    ("p99 queue ms", "p99_queue_ms"),
     ("tokens/s", "tokens_per_s"),
     ("serve goodput", "goodput"),
     ("queue max", "queue_depth_max"),
@@ -91,6 +96,16 @@ def fold_serve_records(records: list[dict]) -> dict | None:
     if reqs:
         fold.update(fold_requests(reqs))
         fold["completed"] = len(reqs)
+        # tail attribution recomputed from the request records, so a
+        # stream truncated before its summary still attributes its p99
+        # (pre-r20 records normalize to zero components, labeled)
+        attr = requests_mod.fold_attribution(reqs)
+        if attr is not None:
+            fold["attribution"] = attr
+        slo_t = (fold.get("slo") or {}).get("slo_e2e_ms") \
+            if isinstance(fold.get("slo"), dict) else None
+        if slo_t:
+            fold["slo"] = fold_burn_rate(reqs, slo_t)
     if compiles:
         c = compiles[-1]
         fold.setdefault("post_warmup_compiles",
@@ -98,6 +113,90 @@ def fold_serve_records(records: list[dict]) -> dict | None:
         fold["compile_buckets"] = c.get("buckets")
         fold["compile_warm"] = c.get("warm")
     return fold
+
+
+DEFAULT_BURN_WINDOWS = 8
+
+
+def fold_burn_rate(request_records: list[dict], slo_e2e_ms: float,
+                   window_s: float | None = None) -> dict | None:
+    """Windowed SLO violation tracking (round 20): violations per
+    rolling window of completion time against an ``--slo_e2e_ms``
+    target — a transient burst lights up one window, sustained
+    overload lights up a *streak*, which endpoint-wide violation
+    counts cannot distinguish.
+
+    ``window_s`` defaults to the run span / ``DEFAULT_BURN_WINDOWS``.
+    Returns None when no target or no completions.
+    """
+    if not slo_e2e_ms or slo_e2e_ms <= 0:
+        return None
+    done = []
+    for r in request_records:
+        e2e, arr = r.get("e2e_ms"), r.get("arrival_s")
+        if isinstance(e2e, (int, float)) and isinstance(arr, (int, float)):
+            done.append((float(arr) + float(e2e) / 1e3, float(e2e)))
+    if not done:
+        return None
+    done.sort()
+    t_lo, t_hi = done[0][0], done[-1][0]
+    span = max(t_hi - t_lo, 1e-9)
+    if window_s is None or window_s <= 0:
+        window_s = span / DEFAULT_BURN_WINDOWS
+    # ceil-based bin count with the t_hi completion clamped into the
+    # last FULL bin — int(span/w)+1 would put the boundary completion
+    # alone in a degenerate trailing window, skewing peak rate and the
+    # streak/SUSTAINED denominators
+    n_win = max(1, int(-(-span // window_s)))
+    wins = [{"t": round(t_lo + i * window_s, 4), "n": 0, "violations": 0}
+            for i in range(n_win)]
+    violations = 0
+    for t, e2e in done:
+        i = min(int((t - t_lo) / window_s), n_win - 1)
+        wins[i]["n"] += 1
+        if e2e > slo_e2e_ms:
+            wins[i]["violations"] += 1
+            violations += 1
+    streak = best_streak = 0
+    peak_rate, peak_t = 0.0, wins[0]["t"]
+    for w in wins:
+        w["rate"] = round(w["violations"] / w["n"], 4) if w["n"] else 0.0
+        if w["violations"]:
+            streak += 1
+            best_streak = max(best_streak, streak)
+        else:
+            streak = 0
+        if w["rate"] > peak_rate:
+            peak_rate, peak_t = w["rate"], w["t"]
+    return {
+        "slo_e2e_ms": slo_e2e_ms,
+        "window_s": round(window_s, 4),
+        "completed": len(done),
+        "violations": violations,
+        "violation_rate": round(violations / len(done), 4),
+        "peak_window_rate": round(peak_rate, 4),
+        "peak_window_t": round(peak_t, 4),
+        "max_violation_streak": best_streak,
+        "windows": wins,
+    }
+
+
+def burn_lines(burn: dict | None) -> list[str]:
+    """The one summarize/engine line for the SLO burn account."""
+    if not burn:
+        return []
+    n_win = len(burn.get("windows", ()))
+    return [
+        f"  slo: e2e <= {burn['slo_e2e_ms']:g}ms — "
+        f"{burn['violations']}/{burn['completed']} violated "
+        f"({burn['violation_rate']:.1%}); worst window "
+        f"{burn['peak_window_rate']:.0%} @ t={burn['peak_window_t']:.1f}s; "
+        f"longest streak {burn['max_violation_streak']}/{n_win} "
+        f"window(s)"
+        + (" — SUSTAINED overload" if n_win
+           and burn["max_violation_streak"] >= max(2, n_win // 2)
+           else "")
+    ]
 
 
 def slo_lines(fold: dict) -> list[str]:
@@ -119,6 +218,16 @@ def slo_lines(fold: dict) -> list[str]:
             f"p50 {fold['p50_e2e_ms']:.1f}  "
             f"p95 {fold['p95_e2e_ms']:.1f}  "
             f"p99 {fold['p99_e2e_ms']:.1f}")
+    if "p50_queue_ms" in fold:
+        # queue wait: the cheapest leading indicator of overload —
+        # folded since round 16, rendered since round 20
+        lines.append(
+            f"  queue ms p50 {fold['p50_queue_ms']:.1f}  "
+            f"p99 {fold['p99_queue_ms']:.1f}")
+    # round 20 (obs.requests): where the p99 lives
+    lines.extend(requests_mod.attribution_lines(
+        fold.get("attribution"), p99_e2e_ms=fold.get("p99_e2e_ms")))
+    lines.extend(burn_lines(fold.get("slo")))
     if fold.get("wall_s") is not None:
         lines.append(
             f"  {fold.get('tokens', 0)} tokens in "
@@ -145,6 +254,9 @@ def slo_lines(fold: dict) -> list[str]:
                if fold.get("decode_block_pages") else "")
             + (f"  worst decode bucket AOT temp {tb / 2**20:.1f} MiB"
                if tb is not None else ""))
+    # round 20: per-bucket occupancy heatmap (padding waste and ladder
+    # sizing read directly off it)
+    lines.extend(requests_mod.bucket_util_lines(fold.get("bucket_util")))
     pwc = fold.get("post_warmup_compiles")
     if pwc is not None:
         lines.append(
@@ -181,6 +293,10 @@ def serve_diff_lines(fold_a: dict | None, fold_b: dict | None) -> list[str]:
         if fold_a.get(key) != fold_b.get(key):
             lines.append(f"  note: {label} differs: "
                          f"{fold_a.get(key)} -> {fold_b.get(key)}")
+    # round 20: component deltas over the slowest decile — a pre-r20
+    # side normalizes to zero components, labeled, never a KeyError
+    lines.extend(requests_mod.attribution_diff_lines(
+        fold_a.get("attribution"), fold_b.get("attribution")))
     return lines
 
 
@@ -197,6 +313,11 @@ def watch_lines(records: list[dict]) -> list[str]:
             f"{s.get('queue_depth', 0)}  in-flight "
             f"{s.get('in_flight', 0)}  free pages "
             f"{s.get('free_pages', '?')}  tokens {s.get('tokens', 0)}")
+        occ = s.get("bucket_occ")
+        if occ:
+            # live per-bucket occupancy column (round 20)
+            lines.append("  bucket occ: " + "  ".join(
+                f"{k} {v:.0%}" for k, v in sorted(occ.items())))
     if fold and "p99_e2e_ms" in fold and fold.get("completed"):
         lines.append(
             f"  {fold['completed']} done  p99 ttft "
