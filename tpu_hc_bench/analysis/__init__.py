@@ -18,6 +18,17 @@ this package is the one reusable home:
 - :mod:`tpu_hc_bench.analysis.lints` — jaxpr/AST lint passes runnable
   against every model in the zoo: host-sync-inside-jit, recompilation
   hazards, donated-buffer misuse, sharding-annotation consistency.
+- :mod:`tpu_hc_bench.analysis.registry` — the pass registry: every
+  check registers name/severity/scope/docs once; the run order, the
+  ``_emit`` default severity, and the README lint table all derive
+  from it.
+- :mod:`tpu_hc_bench.analysis.dataflow` — distributed-correctness
+  passes: an intraprocedural rank-taint engine flagging collectives
+  under rank-divergent control flow, and dict/set-ordered
+  collective-issuing loops.
+- :mod:`tpu_hc_bench.analysis.contracts` — the stream-schema contract
+  checker: keys the obs folds read vs keys the writers materialize,
+  gated by a committed allowlist of documented seams.
 - :mod:`tpu_hc_bench.analysis.report` — findings, JSON reports, and the
   checked-in baseline the CI gate (``tests/test_analysis.py`` +
   ``python -m tpu_hc_bench.analysis``) fails against on regression.
@@ -26,7 +37,9 @@ CLI::
 
     python -m tpu_hc_bench.analysis --model resnet50   # lints + HLO counts
     python -m tpu_hc_bench.analysis --all --json out.json
-    python -m tpu_hc_bench.analysis --update-baseline
+    python -m tpu_hc_bench.analysis --all --changed-only
+    python -m tpu_hc_bench.analysis baseline            # dry-run diff
+    python -m tpu_hc_bench.analysis baseline --update   # atomic rewrite
 """
 
 from tpu_hc_bench.analysis.hlo import (  # noqa: F401
@@ -37,6 +50,12 @@ from tpu_hc_bench.analysis.hlo import (  # noqa: F401
     collective_counts,
     fusion_ops,
     parse_hlo,
+)
+from tpu_hc_bench.analysis.registry import (  # noqa: F401
+    PassInfo,
+    all_passes,
+    pass_index,
+    register_pass,
 )
 from tpu_hc_bench.analysis.report import (  # noqa: F401
     Finding,

@@ -76,19 +76,30 @@ shipped) are checked statically:
   block matched but its partner did not (the asymmetry that makes GSPMD
   insert per-layer reshards at the pjit boundary).
 
-Suppression: append ``# thb:lint-ok[<lint>]`` to the offending line, or
-accept the finding into the checked-in baseline (see ``report.py``).
+Suppression: append ``# tpu-hc: disable=<lint>`` (or the legacy
+``# thb:lint-ok[<lint>]``) to the offending line — suppression hits are
+counted into the findings JSON so they stay auditable — or accept the
+finding into the checked-in baseline (see ``report.py``).
+
+Round 21: the passes register themselves in ``analysis.registry`` (one
+``@register_pass`` per check carrying name/severity/scope/docs), and
+``run()`` iterates the registry instead of a hand-coded sequence — the
+distributed-correctness passes in ``analysis.dataflow`` plug in without
+touching this file.
 """
 
 from __future__ import annotations
 
 import ast
+import collections
 import functools
 import os
 import re
 import symtable
 from pathlib import Path
 
+from tpu_hc_bench.analysis import registry
+from tpu_hc_bench.analysis.registry import register_pass
 from tpu_hc_bench.analysis.report import Finding
 
 __all__ = [
@@ -127,10 +138,13 @@ _NUMPY_ALIASES = {"np", "numpy", "onp"}
 _NUMPY_MATERIALIZERS = {"array", "asarray"}
 
 _SUPPRESS_TOKEN = "thb:lint-ok["
+_DISABLE_RE = re.compile(r"tpu-hc:\s*disable=([A-Za-z0-9_,-]+)")
 
 
 def _suppressed_lines(source: str) -> dict[int, set[str]]:
-    """``# thb:lint-ok[name]`` annotations, by 1-based line number."""
+    """Per-line suppressions, by 1-based line number: the round-21
+    ``# tpu-hc: disable=<name>[,<name>…]`` spelling plus the legacy
+    ``# thb:lint-ok[name]``."""
     out: dict[int, set[str]] = {}
     for i, line in enumerate(source.splitlines(), start=1):
         pos = line.find(_SUPPRESS_TOKEN)
@@ -141,6 +155,10 @@ def _suppressed_lines(source: str) -> dict[int, set[str]]:
             out.setdefault(i, set()).add(
                 line[pos + len(_SUPPRESS_TOKEN):end].strip())
             pos = line.find(_SUPPRESS_TOKEN, end)
+        for m in _DISABLE_RE.finditer(line):
+            out.setdefault(i, set()).update(
+                name.strip() for name in m.group(1).split(",")
+                if name.strip())
     return out
 
 
@@ -187,13 +205,22 @@ class _FileLinter:
             for child in ast.iter_child_nodes(node):
                 self._parents[child] = node
         self.findings: list[Finding] = []
+        self.suppression_hits: collections.Counter = collections.Counter()
 
     # -- shared helpers ------------------------------------------------
 
-    def _emit(self, lint: str, severity: str, node: ast.AST, message: str):
+    def _emit(self, lint: str, node: ast.AST, message: str,
+              severity: str | None = None):
+        """Record a finding.  ``severity`` defaults to the pass's
+        registered severity; pass it explicitly only for a site that
+        deliberately deviates (the recompile pass's info-grade
+        shape-vs-literal branch)."""
         line = getattr(node, "lineno", 0)
         if lint in self.suppressed.get(line, ()):
+            self.suppression_hits[lint] += 1
             return
+        if severity is None:
+            severity = registry.default_severity(lint)
         self.findings.append(Finding(
             lint=lint, severity=severity, model=self.model,
             location=f"{self.filename}:{line}", message=message))
@@ -279,6 +306,12 @@ class _FileLinter:
 
     # -- pass: host sync inside traced code ---------------------------
 
+    @register_pass(
+        HOST_SYNC, "error", "jit",
+        doc="host round-trip (.item(), device_get, np.array on traced "
+            "values) inside traced code — bakes a constant or throws on "
+            "first hardware run",
+        example="`.item()` inside a shard_map'd step fn")
     def _check_host_sync(self, ctx: ast.AST):
         for node in ast.walk(ctx):
             if not isinstance(node, ast.Call):
@@ -289,20 +322,20 @@ class _FileLinter:
                     and node.func.attr in _HOST_SYNC_METHODS \
                     and not node.args:
                 self._emit(
-                    HOST_SYNC, "error", node,
+                    HOST_SYNC, node,
                     f".{node.func.attr}() forces a device->host sync at "
                     f"trace time inside `{getattr(ctx, 'name', '?')}`; "
                     "return the array and sync outside the jitted region")
             elif base in _HOST_SYNC_FUNCS and name.startswith(
                     ("jax.", "device_get", "block_until_ready")):
                 self._emit(
-                    HOST_SYNC, "error", node,
+                    HOST_SYNC, node,
                     f"{name}() inside traced `{getattr(ctx, 'name', '?')}` "
                     "is a host round-trip; hoist it out of the jit")
             elif "." in name and name.split(".", 1)[0] in _NUMPY_ALIASES \
                     and base in _NUMPY_MATERIALIZERS:
                 self._emit(
-                    HOST_SYNC, "error", node,
+                    HOST_SYNC, node,
                     f"{name}() materializes a traced value on host inside "
                     f"`{getattr(ctx, 'name', '?')}`; use jnp instead")
 
@@ -351,6 +384,13 @@ class _FileLinter:
             return set()
         return {s.get_name() for s in table.get_symbols() if s.is_free()}
 
+    @register_pass(
+        RECOMPILE, "warning", "jit",
+        doc="recompilation hazards: traced fn closing over a mutated "
+            "Python scalar (warning), shape-vs-numeric-literal branching "
+            "(info)",
+        example="`for step in range(n): jitted_fn()` where the traced fn "
+                "reads `step` as a free variable")
     def _check_recompile(self, ctx: ast.AST):
         # (a) closure leaks: free vars the enclosing scope mutates
         free = self._free_vars_of(ctx)
@@ -366,7 +406,7 @@ class _FileLinter:
                         mutated.setdefault(node.target.id, node)
                 for name in sorted(free & set(mutated)):
                     self._emit(
-                        RECOMPILE, "warning", mutated[name],
+                        RECOMPILE, mutated[name],
                         f"traced `{getattr(ctx, 'name', '?')}` closes over "
                         f"`{name}`, which this scope mutates — each new "
                         "value bakes a fresh constant and recompiles; pass "
@@ -386,11 +426,11 @@ class _FileLinter:
                            and isinstance(s.value, (int, float))]
                 if shapeish and literal:
                     self._emit(
-                        RECOMPILE, "info", cmp,
+                        RECOMPILE, cmp,
                         "branching on a shape vs a numeric literal forks "
                         "one compilation per shape class; make sure every "
                         "class is intended (use static_argnums/config if "
-                        "it encodes a mode)")
+                        "it encodes a mode)", severity="info")
 
     @staticmethod
     def _mentions_shape(node: ast.AST) -> bool:
@@ -416,6 +456,12 @@ class _FileLinter:
             yield node
             stack.extend(ast.iter_child_nodes(node))
 
+    @register_pass(
+        DONATION, "warning", "file",
+        doc="a buffer passed in a donate_argnums position of a jitted "
+            "call and read again afterwards — donation invalidated it",
+        example="`loss = step(state, batch); print(state)` with "
+                "donate_argnums=(0,)")
     def _check_donation(self):
         """Within each function scope: a name passed in a donated
         position of a jitted callable, then *read* again afterwards.
@@ -472,7 +518,7 @@ class _FileLinter:
                         and node.id in donated_at:
                     call = donated_at.pop(node.id)
                     self._emit(
-                        DONATION, "warning", node,
+                        DONATION, node,
                         f"`{node.id}` was donated to a jitted call "
                         f"(line {call.lineno}) and is read again here "
                         "— the buffer is invalidated by donation; "
@@ -508,6 +554,11 @@ class _FileLinter:
     # does NOT match: its .save is the raw writer the protocol wraps)
     _CKPT_MODULE_ALIASES = {"ckpt", "ckpt_mod", "checkpoint"}
 
+    @register_pass(
+        CKPT_TOPOLOGY, "warning", "file",
+        doc="a checkpoint-writing call site without a topology= sidecar "
+            "— the save resumes on the identical mesh only",
+        example="`ckpt.save(path, state)` with no topology record")
     def _check_checkpoint_topology(self):
         """Checkpoint-writing call sites must pass ``topology=``: the
         elastic-resume sidecar is only as complete as the save paths
@@ -530,7 +581,7 @@ class _FileLinter:
             if any(kw.arg is None for kw in node.keywords):
                 continue    # **kwargs splat: can't see inside
             self._emit(
-                CKPT_TOPOLOGY, "warning", node,
+                CKPT_TOPOLOGY, node,
                 f"checkpoint write `{name}(...)` without a `topology=` "
                 "sidecar record — the checkpoint will refuse/skip "
                 "elastic resume; pass topology.topology_record(...) "
@@ -543,6 +594,13 @@ class _FileLinter:
     # are deliberately exempt)
     _INPUT_PIPELINE_CALLEES = {"ImageNetDataset"}
 
+    @register_pass(
+        INPUT_POOL, "warning", "file",
+        doc="a private input decode pool wider than the host budget cap "
+            "(or full-host-width) — oversubscribes CPUs at "
+            "workers-per-host > 1",
+        example="`ImageNetDataset(decode_workers=cpu_count())` in a "
+                "per-worker pipeline")
     def _check_input_pool(self):
         """An ImageNet/TFRecord pipeline constructed with an explicit
         decode pool wider than the host, or a full-host-width private
@@ -570,7 +628,7 @@ class _FileLinter:
                         and isinstance(v.value, int) \
                         and v.value > limit:
                     self._emit(
-                        INPUT_POOL, "warning", node,
+                        INPUT_POOL, node,
                         f"explicit decode pool width {v.value} exceeds "
                         f"the host budget cap max(32, cpu_count)="
                         f"{limit} — the pool oversubscribes the host; "
@@ -579,7 +637,7 @@ class _FileLinter:
                         "divide by the local worker count")
                 elif self._full_width_expr(v):
                     self._emit(
-                        INPUT_POOL, "warning", node,
+                        INPUT_POOL, node,
                         "private decode pool sized to the FULL host "
                         "(cpu_count()) — at workers-per-host > 1 the "
                         "per-process pools oversubscribe the CPUs and "
@@ -607,6 +665,11 @@ class _FileLinter:
                              "device_memory_sample", "device_memory_stats",
                              "live_buffer_breakdown"}
 
+    @register_pass(
+        HOT_MEMORY, "warning", "file",
+        doc="a device-memory probe in a loop body without a sync-window "
+            "boundary guard — a per-iteration host stall",
+        example="`jax.live_arrays()` called every step of the timed loop")
     def _check_memory_probe_hot_loop(self):
         """A device-memory probe in a loop body must sit behind a
         sync-window boundary guard (a modulo test, or a condition
@@ -626,7 +689,7 @@ class _FileLinter:
             if loop is None or self._window_guarded(node, loop):
                 continue
             self._emit(
-                HOT_MEMORY, "warning", node,
+                HOT_MEMORY, node,
                 f"device-memory probe `{name}(...)` inside a loop body "
                 "without a sync-window boundary guard — each call walks "
                 "the live-buffer table on the host, a per-iteration "
@@ -706,6 +769,13 @@ class _FileLinter:
                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
                 and n.name in names}
 
+    @register_pass(
+        DEQUANT_HOT, "error", "file",
+        doc="elementwise dequantize (`q.astype(f32) * scale`) of a "
+            "cached int8 buffer inside a scan/loop body — a full-width "
+            "f32 copy per iteration",
+        example="`w_q8.astype(jnp.float32) * w_scale` inside the decode "
+                "scan body instead of the scale-fused matmul form")
     def _check_dequant_hot_loop(self):
         """**dequantize-in-hot-loop** (error): ``X.astype(...)`` of a
         quantized/cached int8 buffer used as a bare operand of an
@@ -747,7 +817,7 @@ class _FileLinter:
                 continue
             src = _dotted(node.func.value) or "<expr>"
             self._emit(
-                DEQUANT_HOT, "error", node,
+                DEQUANT_HOT, node,
                 f"`{src}.astype(...) * scale` dequantizes a cached "
                 "int8 buffer elementwise inside a scan/loop body — a "
                 "full-width f32 copy materializes every iteration; "
@@ -779,6 +849,11 @@ class _FileLinter:
                 out.update(a.asname or a.name for a in node.names)
         return out
 
+    @register_pass(
+        SPAN_IN_JIT, "error", "jit",
+        doc="an obs.timeline flight-recorder call inside traced code — "
+            "the host-clock read traces to one frozen timestamp",
+        example="`timeline.span(\"decode\")` inside the AOT'd decode fn")
     def _check_span_in_jit(self, ctx: ast.AST):
         """**span-in-compiled-fn** (error): an ``obs.timeline`` recorder
         call (``span``/``record_span``/``instant``/``transition``)
@@ -806,7 +881,7 @@ class _FileLinter:
                 continue    # a generic .instant()/.transition() that is
                             # not the flight recorder's
             self._emit(
-                SPAN_IN_JIT, "error", node,
+                SPAN_IN_JIT, node,
                 f"flight-recorder call `{name}(...)` inside traced "
                 f"`{getattr(ctx, 'name', '?')}` — the host-clock read "
                 "traces to ONE constant timestamp and the span lies in "
@@ -817,6 +892,13 @@ class _FileLinter:
 
     _SPAN_NAME_CALLEES = {"record_span", "instant", "span"}
 
+    @register_pass(
+        SPAN_REGISTRY, "warning", "file",
+        doc="a literal span name at a recorder call site that is not in "
+            "obs.timeline.KNOWN_SPANS — a typo'd name silently vanishes "
+            "from every fold",
+        example="`record_span(\"prefil\", ...)` — records fine, never "
+                "appears in any timeline")
     def _check_span_name_registry(self):
         """**span-name-registry** (warning): a literal span name passed
         to ``timeline.span``/``record_span``/``instant`` that is not in
@@ -859,7 +941,7 @@ class _FileLinter:
             if arg.value in KNOWN_SPANS:
                 continue
             self._emit(
-                SPAN_REGISTRY, "warning", node,
+                SPAN_REGISTRY, node,
                 f"span name {arg.value!r} at `{name or base}(...)` is "
                 f"not in obs.timeline.KNOWN_SPANS — an unregistered "
                 f"(or typo'd) name records fine and then silently "
@@ -876,6 +958,11 @@ class _FileLinter:
         parts = Path(self.filename).as_posix().split("/")
         return "fleet" in parts and "tests" not in parts
 
+    @register_pass(
+        FLEET_WAIT, "error", "file",
+        doc="a no-timeout .wait()/.join() inside a fleet control-loop "
+            "body — one wedged job freezes scheduling for the pool",
+        example="`proc.wait()` in the supervisor reap loop")
     def _check_fleet_blocking_wait(self):
         """**fleet-blocking-wait** (error, fleet package only): a
         ``.wait()``/``.join()`` call with no timeout inside a loop body
@@ -905,7 +992,7 @@ class _FileLinter:
                 continue
             name = _dotted(node.func) or f"<expr>.{node.func.attr}"
             self._emit(
-                FLEET_WAIT, "error", node,
+                FLEET_WAIT, node,
                 f"unbounded `{name}()` inside a fleet control loop — "
                 "one wedged job blocks scheduling for every other job; "
                 "pass a timeout (`.wait(grace_s)` / "
@@ -927,6 +1014,12 @@ class _FileLinter:
         parts = Path(self.filename).as_posix().split("/")
         return "serve" in parts and "tests" not in parts
 
+    @register_pass(
+        SERVE_RECOMPILE, "warning", "file",
+        doc="a jit/lowering call site in the serve package outside the "
+            "warmup namespace — re-opens the mid-traffic-recompile "
+            "hazard",
+        example="`jax.jit(decode_fn)` reached from the admission path")
     def _check_serve_recompile(self):
         """**serve-bucket-recompile** (warning, serve package only): a
         call site that can reach jit/lowering outside the engine's
@@ -958,7 +1051,7 @@ class _FileLinter:
                 continue
             where = names[0] if names else "module level"
             self._emit(
-                SERVE_RECOMPILE, "warning", node,
+                SERVE_RECOMPILE, node,
                 f"{_dotted(node.func) or base}() in {where} can lower/"
                 f"compile after engine warmup — the serving lane's "
                 f"zero-recompile contract keeps jit/lowering inside "
@@ -968,28 +1061,33 @@ class _FileLinter:
     # -- driver --------------------------------------------------------
 
     def run(self) -> list[Finding]:
+        """Registry-driven pass sequence: every registered jit-scope
+        pass over every traced context, then every file-scope pass
+        (including the ``analysis.dataflow`` distributed-correctness
+        passes, which register themselves on import)."""
+        jit = registry.jit_passes()
         for ctx in self._jit_contexts():
-            self._check_host_sync(ctx)
-            self._check_recompile(ctx)
-            self._check_span_in_jit(ctx)
-        self._check_donation()
-        self._check_checkpoint_topology()
-        self._check_input_pool()
-        self._check_memory_probe_hot_loop()
-        self._check_dequant_hot_loop()
-        self._check_serve_recompile()
-        self._check_fleet_blocking_wait()
-        self._check_span_name_registry()
+            for info in jit:
+                info.func(self, ctx)
+        for info in registry.file_passes():
+            info.func(self)
         return self.findings
 
 
 def lint_source_text(source: str, filename: str = "<string>",
                      model: str = "repo",
-                     cpu_count: int | None = None) -> list[Finding]:
+                     cpu_count: int | None = None,
+                     counters: collections.Counter | None = None
+                     ) -> list[Finding]:
     """AST lint passes over a source string (the test-fixture entry).
     ``cpu_count`` pins the input-pool-width threshold for deterministic
-    tests (default: this host's)."""
-    return _FileLinter(source, filename, model, cpu_count=cpu_count).run()
+    tests (default: this host's).  ``counters`` (optional) accumulates
+    per-lint suppression hits so the findings JSON can audit them."""
+    linter = _FileLinter(source, filename, model, cpu_count=cpu_count)
+    findings = linter.run()
+    if counters is not None:
+        counters.update(linter.suppression_hits)
+    return findings
 
 
 def lint_file(path: str | Path, model: str = "repo") -> list[Finding]:
@@ -997,27 +1095,54 @@ def lint_file(path: str | Path, model: str = "repo") -> list[Finding]:
     return lint_source_text(path.read_text(), str(path), model)
 
 
-def lint_repo_sources(root: str | Path | None = None) -> list[Finding]:
+def lint_repo_sources(root: str | Path | None = None,
+                      files: list[str | Path] | None = None,
+                      counters: collections.Counter | None = None
+                      ) -> list[Finding]:
     """AST passes over every package + scripts source file, plus the
-    tuned-config registry staleness check over ``artifacts/tuned/``."""
+    repo-scope passes: tuned-config registry staleness over
+    ``artifacts/tuned/`` and the stream-schema contract check.
+
+    ``files`` (relative paths under ``root``) restricts the PER-FILE
+    passes to the given sources — the ``--changed-only`` mode; the
+    repo-scope passes always see the whole tree (a contract break can
+    live in an UNchanged file whose partner changed).  ``counters``
+    accumulates suppression hits across files.
+    """
     if root is None:
         root = Path(__file__).resolve().parents[2]
     root = Path(root)
     findings: list[Finding] = []
-    for sub in ("tpu_hc_bench", "scripts"):
-        base = root / sub
-        if not base.is_dir():
+    if files is None:
+        paths: list[Path] = []
+        for sub in ("tpu_hc_bench", "scripts"):
+            base = root / sub
+            if base.is_dir():
+                paths.extend(sorted(base.rglob("*.py")))
+    else:
+        paths = [root / f for f in files]
+    for path in paths:
+        if not path.is_file():
             continue
-        for path in sorted(base.rglob("*.py")):
-            try:
-                rel = str(path.relative_to(root))
-            except ValueError:
-                rel = str(path)
-            findings.extend(lint_source_text(path.read_text(), rel))
+        try:
+            rel = str(path.relative_to(root))
+        except ValueError:
+            rel = str(path)
+        findings.extend(lint_source_text(path.read_text(), rel,
+                                         counters=counters))
     findings.extend(check_tuned_registry(root / "artifacts" / "tuned"))
+    from tpu_hc_bench.analysis import contracts
+
+    findings.extend(contracts.check_stream_contracts(root))
     return findings
 
 
+@register_pass(
+    TUNED_STALENESS, "warning", "repo",
+    doc="a tuned-config registry row recording a flag that no longer "
+        "exists on BenchmarkConfig (or the other lane's lever)",
+    example="artifacts/tuned/v4-8.json records `fuse_steps`, renamed "
+            "two rounds ago — --config=auto silently skips it")
 def check_tuned_registry(
         registry_dir: str | Path | None = None) -> list[Finding]:
     """**tuned-config-staleness** (warning): a tuned-config registry row
@@ -1143,6 +1268,13 @@ def _param_paths(tree) -> list[tuple[str, tuple[int, ...]]]:
     return out
 
 
+@register_pass(
+    SHARDING, "warning", "model",
+    doc="Megatron TP annotation table replayed against the abstract "
+        "param tree: rank drift, indivisible model-axis dims, "
+        "half-annotated column/row blocks",
+    example="`wq/kernel` matched a TP rule but partner `wo/kernel` did "
+            "not — GSPMD reshards at every layer boundary")
 def check_sharding_consistency(name: str) -> list[Finding]:
     """Replay ``tp_param_spec`` over the model's abstract params."""
     from tpu_hc_bench.topology import MODEL_AXIS
@@ -1246,6 +1378,12 @@ def check_jaxpr_host_callbacks(name: str) -> list[Finding]:
     return findings
 
 
+@register_pass(
+    COLLECTIVE_SHAPE, "error", "model",
+    doc="the zero1 arm's lowered HLO missing its reduce-scatter/"
+        "all-gather pair, or gradient buckets riding full all-reduces",
+    example="world=2 zero1 step lowers with 0 reduce-scatters — the "
+            "optimizer states are not actually sharded")
 def check_zero1_collectives(name: str = "trivial", world: int = 2,
                             batch: int = 2,
                             **config_overrides) -> list[Finding]:

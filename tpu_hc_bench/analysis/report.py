@@ -11,15 +11,20 @@ only on findings NOT in the baseline — so adding a new host sync inside
 a jitted region breaks CI, while a deliberate, reviewed exception is one
 baseline entry away.
 
-Suppression: either add the finding's ``key`` to the baseline (the CLI's
-``--update-baseline`` rewrites it from the current tree), or annotate
-the offending source line with ``# thb:lint-ok[<lint-name>]`` which the
-AST lints honor in place.
+Suppression: either add the finding's ``key`` to the baseline (the
+CLI's ``baseline --update`` subcommand rewrites it atomically with a
+loud diff), or annotate the offending source line with
+``# tpu-hc: disable=<lint-name>`` (or the legacy
+``# thb:lint-ok[<lint-name>]``), which the AST lints honor in place —
+suppression hits are counted into the report JSON so they stay
+auditable.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import tempfile
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
 
@@ -63,17 +68,24 @@ class Finding:
 
 @dataclass
 class Report:
-    """Per-run result: findings + any per-model collective counts."""
+    """Per-run result: findings, per-model collective counts, per-lint
+    suppression-hit counts, and the analysis wall time."""
 
     findings: list[Finding] = field(default_factory=list)
     collectives: dict[str, dict[str, int]] = field(default_factory=dict)
+    suppressed: dict[str, int] = field(default_factory=dict)
+    wall_s: float | None = None
 
     def to_json(self) -> str:
-        return findings_to_json(self.findings, self.collectives)
+        return findings_to_json(self.findings, self.collectives,
+                                suppressed=self.suppressed,
+                                wall_s=self.wall_s)
 
 
 def findings_to_json(findings: list[Finding],
                      collectives: dict[str, dict[str, int]] | None = None,
+                     suppressed: dict[str, int] | None = None,
+                     wall_s: float | None = None,
                      ) -> str:
     payload = {
         "findings": [asdict(f) for f in sorted(
@@ -83,6 +95,13 @@ def findings_to_json(findings: list[Finding],
         payload["collectives"] = {
             m: dict(sorted(c.items())) for m, c in sorted(collectives.items())
         }
+    if suppressed:
+        # per-lint inline-suppression hits: a suppressed finding leaves
+        # the findings list but must not leave the audit trail
+        payload["suppressed"] = {k: int(v) for k, v in
+                                 sorted(suppressed.items()) if v}
+    if wall_s is not None:
+        payload["wall_s"] = round(float(wall_s), 3)
     return json.dumps(payload, indent=2, sort_keys=True) + "\n"
 
 
@@ -97,17 +116,42 @@ def load_baseline(path: Path | str = BASELINE_PATH) -> set[str]:
 
 def save_baseline(findings: list[Finding],
                   path: Path | str = BASELINE_PATH,
-                  merge: set[str] = frozenset()) -> None:
+                  merge: set[str] = frozenset()
+                  ) -> tuple[list[str], list[str]]:
     """Write the baseline from ``findings`` (plus ``merge``, for partial
-    runs that must not erase other models' accepted keys)."""
+    runs that must not erase other models' accepted keys).
+
+    Atomic: tmp → fsync → rename in the destination directory (the
+    ``tune_state.json`` idiom), so a crash mid-write can never leave a
+    truncated gate file that silently accepts everything.  Returns the
+    ``(added, removed)`` key diff against the previous baseline so
+    callers can print WHAT changed, not just that something did.
+    """
+    path = Path(path)
+    before = load_baseline(path) if path.exists() else set()
+    accepted = {f.key for f in findings} | set(merge)
     payload = {
         "comment": "Accepted analysis findings; regenerate with "
-                   "`python -m tpu_hc_bench.analysis --all "
-                   "--update-baseline`.  The CI gate fails only on "
-                   "findings whose key is NOT listed here.",
-        "accepted": sorted({f.key for f in findings} | set(merge)),
+                   "`python -m tpu_hc_bench.analysis baseline --update "
+                   "--all`.  The CI gate fails only on findings whose "
+                   "key is NOT listed here.",
+        "accepted": sorted(accepted),
     }
-    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                               prefix=path.name + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps(payload, indent=2) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return sorted(accepted - before), sorted(before - accepted)
 
 
 def compare_to_baseline(findings: list[Finding],
