@@ -111,6 +111,10 @@ def _serve_main() -> int:
             "tail_queue_wait_frac": summary.get("tail_queue_wait_frac"),
             "tail_decode_stall_frac": summary.get(
                 "tail_decode_stall_frac"),
+            # round 22: the allocation-honesty metrics obs regress
+            # gates on (absent on pre-r22 history; the checks skip)
+            "kv_pool_util": summary.get("kv_pool_util"),
+            "kv_req_gap_frac": summary.get("kv_req_gap_frac"),
             "config_source": cfg.config_source,
             "tuned_config": cfg.tuned_config,
         },

@@ -29,6 +29,17 @@ int8 pool's argument-byte shrink, ZERO post-warmup compiles on every
 arm, and token-for-token parity of the f32 arms (read back from the
 per-arm request records).
 
+``--mode kv`` (round 22) is the allocation-honesty A/B: the
+worst-case-reservation control (gather/off) vs int8_kv, one engine per
+arm, same trace.  The headline is the control's measured
+``kv_pool_util`` (written-page-seconds / reserved-page-seconds, from
+the ``obs.kv`` ledger) — the baseline the on-demand-paging ROADMAP
+item must move — plus the per-request reservation gap, restated in
+wasted pool bytes at each arm's page cost.
+
+Every mode folds the per-arm KV-pool ledger (``kv_pool`` /
+``kv_pool_util`` / ``kv_req_gap_frac``) into its arms.
+
 Both modes emit a BENCH-style JSON record with
 ``decode_attention``/``quant``/``aot_decode_temp_bytes`` in ``extra``
 (the fields ``obs regress``/``obs diff`` track) plus ``obs
@@ -122,6 +133,13 @@ def run_ab(args) -> dict:
             # A/B's WHY column: static's p99 lives in queue_wait/
             # decode_stall, continuous moves it back to decode_active
             "attribution": summary.get("attribution"),
+            # round 22 (obs.kv): the pool ledger per arm — static's
+            # fill-then-drain pattern and continuous' refill-per-step
+            # produce different written/reserved integrals on the SAME
+            # reservation policy
+            "kv_pool": summary.get("kv_pool"),
+            "kv_pool_util": summary.get("kv_pool_util"),
+            "kv_req_gap_frac": summary.get("kv_req_gap_frac"),
             "metrics_dir": mdir,
         }
 
@@ -187,6 +205,9 @@ def run_ab(args) -> dict:
             # the regress gate's attribution-shift metrics (headline =
             # continuous arm, matching the other extras)
             **requests_mod.flatten_attribution(ct_attr),
+            # round 22: the regress gate's allocation-honesty metric
+            "kv_pool_util": ct.get("kv_pool_util"),
+            "kv_req_gap_frac": ct.get("kv_req_gap_frac"),
             # the static-vs-continuous attribution delta as `obs diff`
             # renders it (also viewable live: obs diff <root>/static
             # <root>/continuous)
@@ -247,6 +268,11 @@ def run_decode_ab(args) -> dict:
             "aot_decode_temp_bytes": summary["aot_decode_temp_bytes"],
             "post_warmup_compiles": summary["post_warmup_compiles"],
             "attribution": summary.get("attribution"),
+            # round 22 (obs.kv): the pool ledger per arm
+            "kv_pool": summary.get("kv_pool"),
+            "kv_pool_util": summary.get("kv_pool_util"),
+            "kv_req_gap_frac": summary.get("kv_req_gap_frac"),
+            "kv_pool_bytes": summary.get("kv_pool_bytes"),
             "metrics_dir": mdir,
         }
         wk, wma = engine.aot_memory_worst(kinds=("decode",))
@@ -307,6 +333,121 @@ def run_decode_ab(args) -> dict:
             "p99_ms": pa["p99_e2e_ms"],
             "goodput": pa["goodput"],
             "tokens_per_s": pa["tokens_per_s"],
+            "kv_pool_util": pa.get("kv_pool_util"),
+            "kv_req_gap_frac": pa.get("kv_req_gap_frac"),
+            "arms": arms,
+            "verdict": verdict,
+        },
+        "manifest": manifest,
+    }
+
+
+KV_ARMS = (("gather", "off"), ("paged", "int8_kv"))
+
+
+def run_kv_ab(args) -> dict:
+    """The round-22 KV-pool honesty A/B: the worst-case-reservation
+    control (gather/off — the allocation policy EVERY arm shares) vs
+    the int8_kv arm (same reservation policy, quarter the bytes per
+    written page), one engine per arm, same seeded trace, continuous
+    batching.  The headline is the control's measured ``kv_pool_util``
+    — written-page-seconds over reserved-page-seconds, the number the
+    on-demand-paging ROADMAP item must move; the per-request honesty
+    gap (pages reserved vs pages written at retirement) says how much
+    of the pool a length-aware admission could reclaim TODAY, and the
+    int8_kv arm converts the same gap into wasted bytes at the smaller
+    page cost."""
+    import tempfile
+
+    from tpu_hc_bench.obs import metrics as obs_metrics
+    from tpu_hc_bench.serve import cli as serve_cli
+
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    root = args.metrics_root or tempfile.mkdtemp(prefix="bench_kv_")
+    arms: dict[str, dict] = {}
+    base_cfg = None
+    for da, q in KV_ARMS:
+        arm = f"{da}+{q}"
+        cfg = _build_cfg(args, decode_attention=da, quant=q,
+                         decode_block_pages=(args.decode_block_pages
+                                             if da == "paged" else 0))
+        base_cfg = base_cfg or cfg
+        log(f"--- kv arm: {arm} ---")
+        engine, requests = serve_cli.build_engine_and_requests(cfg, log)
+        mdir = os.path.join(root, arm.replace("+", "_"))
+        summary = serve_cli.run_serve(
+            engine, requests, serve_cli.serve_writer(cfg, mdir),
+            batching="continuous")
+        kvf = summary.get("kv_pool") or {}
+        arms[arm] = {
+            "decode_attention": da,
+            "quant": q,
+            "kv_pool": summary.get("kv_pool"),
+            "kv_pool_util": summary.get("kv_pool_util"),
+            "kv_req_gap_frac": summary.get("kv_req_gap_frac"),
+            "kv_pool_bytes": summary.get("kv_pool_bytes"),
+            "kv_scale_bytes": summary.get("kv_scale_bytes"),
+            "kv_pages": engine.num_pages,
+            "kv_page_size": engine.page_size,
+            # the gap in BYTES at this arm's page cost: the fraction of
+            # reserved page-seconds never written, times the pool size
+            "wasted_pool_bytes": (
+                round((1.0 - kvf["util"]) * summary["kv_pool_bytes"])
+                if isinstance(kvf.get("util"), (int, float))
+                and summary.get("kv_pool_bytes") else None),
+            "tokens_per_s": summary["tokens_per_s"],
+            "p99_e2e_ms": summary["p99_e2e_ms"],
+            "goodput": summary["goodput"],
+            "completed": summary["completed"],
+            "post_warmup_compiles": summary["post_warmup_compiles"],
+            "metrics_dir": mdir,
+        }
+
+    ctl = arms[f"{KV_ARMS[0][0]}+{KV_ARMS[0][1]}"]
+    kv8 = arms[f"{KV_ARMS[1][0]}+{KV_ARMS[1][1]}"]
+    util = ctl.get("kv_pool_util")
+    gap = ctl.get("kv_req_gap_frac")
+    verdict = {
+        # the measurement exists and is a real gap, not a rounding
+        # artifact: worst-case reservation writes strictly less than it
+        # reserves whenever any output runs short of max
+        "gap_measured": (isinstance(util, (int, float)) and util < 1.0
+                         and isinstance(gap, (int, float)) and gap > 0.0),
+        "control_kv_pool_util": util,
+        "control_req_gap_frac": gap,
+        # both arms run the same reservation policy: the honesty gap is
+        # a property of admission, not of the page encoding
+        "same_gap_across_arms": (
+            ctl.get("kv_req_gap_frac") == kv8.get("kv_req_gap_frac")),
+        "int8_wasted_pool_bytes": kv8.get("wasted_pool_bytes"),
+        "control_wasted_pool_bytes": ctl.get("wasted_pool_bytes"),
+        "zero_post_warmup_compiles": all(
+            a["post_warmup_compiles"] == 0 for a in arms.values()),
+        "all_completed": all(a["completed"] == args.num_requests
+                             for a in arms.values()),
+    }
+    manifest = obs_metrics.manifest_subset(
+        obs_metrics.run_manifest(cfg=base_cfg))
+    return {
+        "metric": f"{args.model}_kv_pool_util",
+        "value": util,
+        "unit": "written_page_s/reserved_page_s",
+        "vs_baseline": None,
+        "extra": {
+            "workload": "serve",
+            "mode": "kv",
+            "model": args.model,
+            "arrival_rate": args.arrival_rate,
+            "num_requests": args.num_requests,
+            "max_prompt_len": args.max_prompt_len,
+            "max_output_len": args.max_output_len,
+            "kv_page_size": args.kv_page_size,
+            "decode_attention": KV_ARMS[0][0],
+            "quant": KV_ARMS[0][1],
+            "kv_pool_util": util,
+            "kv_req_gap_frac": gap,
+            "goodput": ctl["goodput"],
+            "tokens_per_s": ctl["tokens_per_s"],
             "arms": arms,
             "verdict": verdict,
         },
@@ -330,11 +471,14 @@ def main() -> int:
     ap.add_argument("--kv_page_size", type=int, default=16)
     ap.add_argument("--max_prompt_len", type=int, default=32)
     ap.add_argument("--max_output_len", type=int, default=16)
-    ap.add_argument("--mode", choices=["batching", "decode"],
+    ap.add_argument("--mode", choices=["batching", "decode", "kv"],
                     default=env("BENCH_MODE", "batching"),
                     help="batching: continuous-vs-static on one warmed "
                          "engine; decode: gather-vs-paged-vs-int8 "
-                         "kernel arms, one engine each")
+                         "kernel arms, one engine each; kv: the "
+                         "round-22 allocation-honesty A/B — "
+                         "worst-case-reservation control vs int8_kv, "
+                         "headline = measured kv_pool_util")
     ap.add_argument("--decode_attention",
                     choices=["gather", "paged"],
                     default=env("BENCH_DECODE_ATTENTION", "gather"),
@@ -358,8 +502,8 @@ def main() -> int:
                     help="also write the comparison JSON here")
     args = ap.parse_args()
 
-    result = run_decode_ab(args) if args.mode == "decode" \
-        else run_ab(args)
+    result = {"decode": run_decode_ab, "kv": run_kv_ab}.get(
+        args.mode, run_ab)(args)
     print(json.dumps(result, indent=1))
     if args.json:
         with open(args.json, "w") as f:
@@ -369,6 +513,9 @@ def main() -> int:
     if args.mode == "decode":
         ok = (v["paged_temp_lt_gather"] and v["paged_token_parity"]
               and v["zero_post_warmup_compiles"] and v["all_completed"])
+    elif args.mode == "kv":
+        ok = (v["gap_measured"] and v["zero_post_warmup_compiles"]
+              and v["all_completed"])
     else:
         ok = (v["continuous_beats_static_p99"]
               and v["continuous_beats_static_goodput"]

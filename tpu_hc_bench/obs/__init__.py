@@ -49,6 +49,13 @@ Where ``analysis`` inspects the *compiled program* (HLO, jaxpr),
   renders component deltas, ``regress`` gates on attribution shift),
   per-bucket occupancy folds, and per-request Chrome-trace lanes
   merged into the ``timeline`` view.
+- ``obs.kv`` — the KV-pool utilization ledger (serving lane):
+  ``kv_pool_util`` (written-page-seconds / reserved-page-seconds) from
+  the engine's periodic pool snapshots, the per-request reservation
+  honesty gap (``pages_reserved`` vs ``pages_final`` at retirement),
+  the r20 ``queue_wait`` component's cause split (``pool_starved`` vs
+  ``batch_full`` — WHICH resource gated the tail), and the pool
+  occupancy counter track merged into the ``timeline`` view.
 - ``python -m tpu_hc_bench.obs`` — ``summarize`` renders either
   artifact kind (a metrics run or a raw trace directory); ``diff``
   compares two runs at bucket/metric granularity, so a regression

@@ -191,6 +191,11 @@ def main(argv: list[str] | None = None, out=None) -> int:
                 print(f"request lanes: {lanes} request(s) rendered as "
                       f"their own timeline rows (pid 'requests')",
                       file=out)
+            kv_samples = trace["metadata"].get("kv_counter_samples", 0)
+            if kv_samples:
+                print(f"kv pool track: {kv_samples} occupancy sample(s) "
+                      f"rendered as a counter track (pid 'kv pool')",
+                      file=out)
             print(f"chrome trace written: {path} (open in "
                   f"chrome://tracing or https://ui.perfetto.dev)",
                   file=out)

@@ -151,7 +151,8 @@ class FleetWriter:
         return self._f is not None
 
     def heartbeat(self, step: int, step_ewma_ms: float,
-                  mem_peak_bytes: int | None = None, **extra) -> None:
+                  mem_peak_bytes: int | None = None,
+                  kv_peak_pages: int | None = None, **extra) -> None:
         if self._f is None:
             return
         rec = {"kind": "heartbeat", "host": self.process_index,
@@ -162,6 +163,11 @@ class FleetWriter:
             # the ONE heartbeat memory field name — readers
             # (watch/summarize) consume it via heartbeat_mem_peak
             rec["mem_peak_bytes"] = int(mem_peak_bytes)
+        if kv_peak_pages:
+            # serve-lane KV pool high-water (round 22) — writer and the
+            # heartbeat_kv_peak reader land in the same PR, per the
+            # round-15 mem_peak_bytes lesson
+            rec["kv_peak_pages"] = int(kv_peak_pages)
         rec.update(extra)
         try:
             self._f.write(json.dumps(rec, default=str) + "\n")
@@ -294,6 +300,14 @@ def heartbeat_mem_peak(rec: dict) -> int | None:
     ``mem_peak_bytes`` name (round 15); falls back to the pre-unification
     ``peak_bytes_in_use`` spelling so old run dirs still render."""
     v = rec.get("mem_peak_bytes", rec.get("peak_bytes_in_use"))
+    return int(v) if v else None
+
+
+def heartbeat_kv_peak(rec: dict) -> int | None:
+    """The serve-lane heartbeat's KV-pool high-water (``kv_peak_pages``,
+    round 22); ``None`` on train-lane and pre-r22 heartbeats — readers
+    render absent, never KeyError."""
+    v = rec.get("kv_peak_pages")
     return int(v) if v else None
 
 

@@ -60,6 +60,12 @@ CHECKS = (
     (("extra", "tail_queue_wait_frac"), "lower", "tail queue_wait frac"),
     (("extra", "tail_decode_stall_frac"), "lower",
      "tail decode_stall frac"),
+    # round 22 (obs.kv): allocation honesty — written-page-seconds over
+    # reserved-page-seconds.  A DROP means admission got more
+    # pessimistic (or outputs shortened against a fixed reservation)
+    # and the pool wastes more of its bytes; pre-r22 serve history
+    # lacks the field and the check skips (never KeyError)
+    (("extra", "kv_pool_util"), "higher", "kv pool util"),
 )
 
 #: identity fields folded into the fingerprint (record path order)
@@ -93,6 +99,8 @@ DEFAULT_REL_FLOOR = 0.03
 ABS_FLOORS = {
     "tail queue_wait frac": 0.05,
     "tail decode_stall frac": 0.05,
+    # round 22: utilization is a fraction with the same jitter shape
+    "kv pool util": 0.05,
 }
 
 

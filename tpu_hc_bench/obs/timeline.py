@@ -72,6 +72,9 @@ KNOWN_SPANS = frozenset((
     "svc_decode", "ring_put", "ring_get",
     # serve engine
     "prefill", "decode", "classify", "admit", "retire",
+    # serve admission forensics (round 22): edge-triggered instants the
+    # moment the queue blocks on a resource
+    "pool_starved", "batch_full",
     # checkpoint
     "ckpt_snapshot", "ckpt_write", "ckpt_restore",
 )) | _PHASE_LANE_NAMES
@@ -502,7 +505,10 @@ def merge_chrome_trace(run_dir: str) -> dict:
     Serving runs (round 20): the run dir's ``metrics.jsonl`` request
     records additionally render as per-request lanes
     (``obs.requests.request_trace_events``) beside the rank spans, so a
-    single slow request is traceable through the engine.
+    single slow request is traceable through the engine.  Round 22 adds
+    the KV-pool occupancy counter track (``obs.kv.kv_counter_events``,
+    "C"-phase stacked written/reserved/free pages), so a pool-full
+    admission stall is visually attributable.
 
     Raises FileNotFoundError when the run dir has no spans files."""
     per_rank = read_spans(run_dir)
@@ -525,12 +531,15 @@ def merge_chrome_trace(run_dir: str) -> dict:
             t0 = float(s["t0"])
             aligned.append(
                 (rank, s, t0 + (clock.offset_at(t0) if clock else 0.0)))
-    # per-request lanes from the metrics stream (serving runs; a
-    # training run simply has no request records here)
+    # per-request lanes + the KV-pool counter track from the metrics
+    # stream (serving runs; a training run simply has neither record
+    # kind here)
+    from tpu_hc_bench.obs import kv as kv_mod
     from tpu_hc_bench.obs import requests as requests_mod
 
-    req_events = requests_mod.request_trace_events(
-        _metrics_records(run_dir))
+    metrics_records = _metrics_records(run_dir)
+    req_events = requests_mod.request_trace_events(metrics_records)
+    req_events.extend(kv_mod.kv_counter_events(metrics_records))
     t_base = min(t for _, _, t in aligned)
     if req_events:
         t_base = min(t_base, min(e["ts_unix"] for e in req_events
@@ -564,6 +573,11 @@ def merge_chrome_trace(run_dir: str) -> dict:
                          "request_lanes": sum(
                              1 for e in req_events
                              if e.get("name") == "queue_wait"),
+                         # round 22: pool-occupancy counter samples
+                         # ("C"-phase events on the kv-pool track)
+                         "kv_counter_samples": sum(
+                             1 for e in req_events
+                             if e.get("ph") == "C"),
                          "t_base_unix": t_base}}
 
 

@@ -73,6 +73,14 @@ def render(path: str, manifest: dict, records: list[dict],
     if len(mem_peaks) > 1:
         lines.append(f"  fleet mem peak: {max(mem_peaks) / 2**20:.1f} MiB "
                      f"max across {len(mem_peaks)} host(s)")
+    # fleet KV pressure (round 22): the serve lane's pool high-water
+    # off each host's freshest beat — reader lands with the writer
+    kv_peaks = [(h, p) for h, p in (
+        (h, fleet_mod.heartbeat_kv_peak(recs[-1]))
+        for h, recs in sorted(beats.items()) if recs) if p]
+    if kv_peaks:
+        lines.append("  kv peak pages: " + "  ".join(
+            f"rank{h} {p}" for h, p in kv_peaks[:8]))
     # per-rank current phase (round 17): the newest flight-recorder span
     # each rank stamped into its heartbeat — a hung fleet shows WHERE
     # each rank is stuck, not just that its step counter stopped

@@ -50,12 +50,28 @@ def serve_writer(cfg, metrics_dir):
 
 
 def run_serve(engine, requests, writer, *, batching=None, clock=None):
-    """One closed loop with the writer closed on every exit path."""
+    """One closed loop with the writer(s) closed on every exit path.
+
+    A metrics-enabled run also gets a FleetWriter beside the metrics
+    stream (round 22): the engine heartbeats at serve-record cadence
+    carrying ``kv_peak_pages``, so ``obs watch``'s fleet view shows
+    per-host KV pressure.  process_index is pinned to 0 — the serve
+    lane is single-process today and the FleetWriter default would
+    touch ``jax.process_index()`` (a device round-trip) from the hot
+    path's setup."""
+    fleet = None
+    out_dir = getattr(writer, "out_dir", None)
+    if out_dir:
+        from tpu_hc_bench.obs import fleet as fleet_mod
+
+        fleet = fleet_mod.FleetWriter(out_dir, process_index=0)
     try:
         return engine.run(requests, batching=batching, writer=writer,
-                          clock=clock)
+                          clock=clock, fleet=fleet)
     finally:
         writer.close()
+        if fleet is not None:
+            fleet.close()
 
 
 def main(argv: list[str] | None = None,

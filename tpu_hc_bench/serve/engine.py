@@ -45,6 +45,7 @@ import numpy as np
 
 from tpu_hc_bench.flags import BenchmarkConfig, parse_serve_buckets
 from tpu_hc_bench.obs import efficiency as obs_efficiency
+from tpu_hc_bench.obs import kv as kv_mod
 from tpu_hc_bench.obs import metrics as obs_metrics
 from tpu_hc_bench.obs import requests as requests_mod
 from tpu_hc_bench.obs import timeline as timeline_mod
@@ -84,19 +85,84 @@ class PageAllocator:
                 f"page): {num_pages}")
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, 0, -1))
+        # round 22 ledger counters (host ints the kv_pool record stamps
+        # for free): pool high-water in pages-in-use, and recycled
+        # allocations — a page handed out again after a free, the
+        # pool-churn signal a leak (pages freed but never reused) hides
+        self.pages_peak = 0
+        self.recycled = 0
+        self._ever_used = [False] * num_pages
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - 1 - len(self._free)
+
     def alloc(self, n: int) -> list[int] | None:
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            if self._ever_used[p]:
+                self.recycled += 1
+            else:
+                self._ever_used[p] = True
+        if self.used_pages > self.pages_peak:
+            self.pages_peak = self.used_pages
         return out
 
     def free(self, pages: list[int]) -> None:
         self._free.extend(pages)
+
+
+class KVLedger:
+    """Round 22 (obs.kv): the KV-pool utilization ledger — pages
+    reserved by admission vs pages actually written, integrated over
+    step wall into the page-seconds behind ``kv_pool_util``.
+
+    Writer-side bookkeeping, by declared limit: "written" is inferred
+    from scheduler state (prompt length at admit, one token per decode
+    step), not device introspection — the compiled programs do write
+    those slots, but nothing here reads HBM back.  Every update is a
+    couple of host int/float ops, pinned under the round-17
+    1%-of-step-wall guard by test.
+    """
+
+    __slots__ = ("page_size", "reserved_now", "written_now",
+                 "reserved_page_s", "written_page_s")
+
+    def __init__(self, page_size: int):
+        self.page_size = page_size
+        self.reserved_now = 0       # pages held by in-flight requests
+        self.written_now = 0        # pages with >= 1 written token
+        self.reserved_page_s = 0.0
+        self.written_page_s = 0.0
+
+    def admit(self, pages_reserved: int, prompt_len: int) -> None:
+        self.reserved_now += pages_reserved
+        self.written_now += -(-prompt_len // self.page_size)
+
+    def token(self, length_before: int) -> None:
+        # one appended token touches a new page iff the pre-append
+        # length sits on a page boundary — O(1) per generated token
+        if length_before % self.page_size == 0:
+            self.written_now += 1
+
+    def retire(self, pages_reserved: int, length: int) -> int:
+        """Release a request's pages; returns its final written-page
+        count (== peak under worst-case reservation: lengths only grow
+        and pages free only at retirement)."""
+        final = -(-length // self.page_size)
+        self.reserved_now -= pages_reserved
+        self.written_now -= final
+        return final
+
+    def charge(self, dt: float) -> None:
+        self.reserved_page_s += self.reserved_now * dt
+        self.written_page_s += self.written_now * dt
 
 
 class MonotonicClock:
@@ -263,6 +329,11 @@ class ServeEngine:
         # --- warmup: AOT-compile every bucket ---
         self.compiled: dict[tuple[str, int], Any] = {}
         self.lower_count = 0
+        # pool geometry bytes (round 22: the serve summary renders the
+        # configured pool beside the utilization line) — measured off
+        # the actual device arrays at warmup, None for classify members
+        self.kv_pool_bytes: int | None = None
+        self.kv_scale_bytes = 0
         t0 = time.perf_counter()
         if self.decode_mode:
             self._warm_decode()
@@ -387,6 +458,15 @@ class ServeEngine:
         self._kv = decode_mod.init_kv_state(
             self.family, self.num_pages, self.page_size,
             jnp.dtype(self.cfg.compute_dtype), quant=self.quant)
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self._kv)
+        self.kv_pool_bytes = int(sum(x.nbytes for x in leaves))
+        if self.quant == "int8_kv":
+            # the per-(layer, page) f32 scale planes ride the pool
+            # bytes — int8 pages without their scales would undercount
+            self.kv_scale_bytes = int(sum(
+                x.nbytes for x in leaves if x.dtype == jnp.float32))
         w = self.table_width
         for s in self.prefill_buckets:
             fn = decode_mod.build_prefill_fn(
@@ -440,12 +520,18 @@ class ServeEngine:
 
     def run(self, requests: list[Request], batching: str | None = None,
             writer: obs_metrics.MetricsWriter | None = None,
-            clock=None) -> dict:
+            clock=None, fleet=None) -> dict:
         """Play a request trace; returns the serve summary record.
 
         Deterministic given (engine seed, trace, clock): greedy decode,
         counter-keyed synthesis, and arrival-ordered admission leave no
         hidden state between runs — arms share one warmed engine.
+
+        ``fleet`` is an optional ``obs.fleet.FleetWriter``: when given
+        (``serve/cli.run_serve`` wires one on metrics runs) the engine
+        heartbeats at the serve-record cadence with the pool high-water
+        under ``kv_peak_pages``, so ``obs watch``'s fleet view shows
+        per-host KV pressure the same way it shows ``mem_peak_bytes``.
         """
         batching = batching or self.cfg.batching
         if batching not in ("continuous", "static"):
@@ -460,6 +546,10 @@ class ServeEngine:
         clock = clock or MonotonicClock()
         allocator = PageAllocator(self.num_pages) if self.decode_mode \
             else None
+        ledger = KVLedger(self.page_size) if self.decode_mode else None
+        # queue-wait cause split (round 22): rid -> accumulated seconds
+        # blocked on [pool_starved, batch_full] while sitting in queue
+        wait_causes: dict[int, list[float]] = {}
         pending = sorted(requests, key=lambda r: (r.arrival_s, r.rid))
         n = len(pending)
         if self.decode_mode:
@@ -524,10 +614,26 @@ class ServeEngine:
                  else fl.t_admit),
                 fl.t_last if fl.t_last is not None else t_done,
                 t_done, fl.active_s))
+            # queue-wait cause split (obs.kv): which resource this
+            # request's queue_ms was blocked on; the remainder (if any)
+            # is arrival-to-first-scheduler-look alignment, not a
+            # resource
+            causes = wait_causes.pop(fl.req.rid, None) or [0.0, 0.0]
+            rec["queue_pool_starved_ms"] = round(1e3 * causes[0], 3)
+            rec["queue_batch_full_ms"] = round(1e3 * causes[1], 3)
             if self.decode_mode:
                 # the greedy token ids (synthetic anyway) — the decode
                 # parity tests and postmortems read them; <= 32 ints
                 rec["generated"] = list(fl.out_tokens)
+                # per-request KV footprint (obs.kv): the honesty gap —
+                # worst-case pages reserved at admission vs pages that
+                # ever held a token.  peak == final under worst-case
+                # reservation; they diverge once mid-flight release
+                # (on-demand paging) lands
+                final_pages = ledger.retire(len(fl.pages), fl.length)
+                rec["pages_reserved"] = len(fl.pages)
+                rec["pages_peak_used"] = final_pages
+                rec["pages_final"] = final_pages
             done.append(rec)
             writer.event("request", **rec)
             timeline_mod.instant("retire", rid=fl.req.rid)
@@ -545,6 +651,7 @@ class ServeEngine:
                 return
             pages = allocator.alloc(self.table_width)
             assert pages is not None, "admission checked free_pages"
+            ledger.admit(len(pages), req.prompt_len)
             table = np.asarray(pages, np.int32)
             s = pick_bucket(self.prefill_buckets, req.prompt_len)
             toks = np.zeros((1, s), np.int32)
@@ -563,6 +670,7 @@ class ServeEngine:
             tokens_out += 1
             productive_s += dt * (req.prompt_len / s)
             bucket_acct("prefill", s, req.prompt_len, dt)
+            ledger.charge(dt)
             fl = _InFlight(req=req, pages=pages, table=table,
                            length=req.prompt_len, produced=1,
                            last_token=int(next_tok[0]), t_admit=t_admit,
@@ -593,12 +701,14 @@ class ServeEngine:
             tokens_out += len(active)
             productive_s += dt * (len(active) / b)
             bucket_acct("decode", b, len(active), dt)
+            ledger.charge(dt)
             next_toks = np.asarray(next_toks)
             t_done = now()
             still: list[_InFlight] = []
             for i, fl in enumerate(active):
                 fl.last_token = int(next_toks[i])
                 fl.out_tokens.append(fl.last_token)
+                ledger.token(fl.length)
                 fl.length += 1
                 fl.produced += 1
                 fl.active_s += dt
@@ -631,6 +741,7 @@ class ServeEngine:
                 finish(fl, t_done)
             active.clear()
 
+        last_blocked: str | None = None
         while len(done) < n:
             t = now()
             while idx < n and pending[idx].arrival_s <= t:
@@ -658,6 +769,34 @@ class ServeEngine:
                     for _ in range(min(want, len(queue))):
                         admit(queue.popleft())
                         progressed = True
+            # admission forensics (round 22, obs.kv): when requests
+            # stay queued past the admission pass, name the BINDING
+            # resource — the scaling-policy input.  Continuous: a full
+            # batch gates before a full pool (freeing pages would not
+            # open a slot), so batch_full wins when both bind.  Static:
+            # the run-to-completion batch policy is always the gate —
+            # even a pool-capped batch admits nothing mid-flight, so
+            # scale-out (not pool growth) is the remedy.
+            blocked_cause = None
+            if queue:
+                if batching != "continuous":
+                    blocked_cause = "batch_full"
+                elif len(active) >= self.cap:
+                    blocked_cause = "batch_full"
+                elif allocator is not None and \
+                        allocator.free_pages < self.table_width:
+                    blocked_cause = "pool_starved"
+            if blocked_cause != last_blocked:
+                # edge-triggered flight-recorder instants: the moment
+                # admission blocks on (or frees from) a resource —
+                # bounded by transitions, not steps
+                if blocked_cause == "pool_starved":
+                    timeline_mod.instant("pool_starved",
+                                         queued=len(queue))
+                elif blocked_cause == "batch_full":
+                    timeline_mod.instant("batch_full", queued=len(queue))
+                last_blocked = blocked_cause
+            t_blocked = now()
             if active:
                 decode_step() if self.decode_mode else classify_step()
                 progressed = True
@@ -667,28 +806,87 @@ class ServeEngine:
                         "serve engine stalled: queued requests, nothing "
                         "in flight, no capacity — KV pool undersized?")
                 clock.sleep(pending[idx].arrival_s - now())
+            if blocked_cause is not None:
+                # charge the elapsed step/sleep to the blocking cause
+                # for every request that sat in queue through it (they
+                # rejoin admission only at the next loop top)
+                dt_blk = now() - t_blocked
+                if dt_blk > 0:
+                    ci = 0 if blocked_cause == "pool_starved" else 1
+                    for r in queue:
+                        wait_causes.setdefault(
+                            r.rid, [0.0, 0.0])[ci] += dt_blk
             total_steps = sum(steps.values())
-            if (total_steps - last_record_step >= _SERVE_RECORD_EVERY
-                    and writer.enabled):
+            if total_steps - last_record_step >= _SERVE_RECORD_EVERY:
                 last_record_step = total_steps
-                writer.event(
-                    "serve", t=round(now(), 4), queue_depth=len(queue),
-                    in_flight=len(active),
-                    free_pages=(allocator.free_pages
-                                if allocator else None),
-                    tokens=tokens_out,
-                    # running per-bucket occupancy — `obs watch`'s live
-                    # utilization column
-                    bucket_occ={k: round(u[2] / u[1], 3)
-                                for k, u in butil.items() if u[1]},
-                    **{f"{k}_steps": v for k, v in steps.items()})
+                if writer.enabled:
+                    writer.event(
+                        "serve", t=round(now(), 4),
+                        queue_depth=len(queue),
+                        in_flight=len(active),
+                        free_pages=(allocator.free_pages
+                                    if allocator else None),
+                        tokens=tokens_out,
+                        # running per-bucket occupancy — `obs watch`'s
+                        # live utilization column
+                        bucket_occ={k: round(u[2] / u[1], 3)
+                                    for k, u in butil.items() if u[1]},
+                        **{f"{k}_steps": v for k, v in steps.items()})
+                    if ledger is not None:
+                        # the pool ledger snapshot: counters the engine
+                        # already holds — no device round-trips
+                        writer.event(
+                            "kv_pool", t=round(now(), 4),
+                            pages_reserved=ledger.reserved_now,
+                            pages_written=ledger.written_now,
+                            free_pages=allocator.free_pages,
+                            pages_peak=allocator.pages_peak,
+                            pages_recycled=allocator.recycled,
+                            reserved_page_s=round(
+                                ledger.reserved_page_s, 6),
+                            written_page_s=round(
+                                ledger.written_page_s, 6))
+                if fleet is not None:
+                    fleet.heartbeat(
+                        step=total_steps,
+                        step_ewma_ms=1e3 * now() / max(1, total_steps),
+                        kv_peak_pages=(allocator.pages_peak
+                                       if allocator else None),
+                        phase="serve")
 
         if self.decode_mode:
             self._kv = kv
         wall = max(now(), 1e-9)
+        if ledger is not None and writer.enabled:
+            # terminal ledger snapshot: runs shorter than one record
+            # window still land their cumulative page-second integrals
+            writer.event(
+                "kv_pool", t=round(now(), 4),
+                pages_reserved=ledger.reserved_now,
+                pages_written=ledger.written_now,
+                free_pages=allocator.free_pages,
+                pages_peak=allocator.pages_peak,
+                pages_recycled=allocator.recycled,
+                reserved_page_s=round(ledger.reserved_page_s, 6),
+                written_page_s=round(ledger.written_page_s, 6))
+        if fleet is not None:
+            fleet.heartbeat(
+                step=sum(steps.values()),
+                step_ewma_ms=1e3 * wall / max(1, sum(steps.values())),
+                kv_peak_pages=(allocator.pages_peak
+                               if allocator else None),
+                phase="serve")
         entries_final = self._count_cache()
         fold = slo_mod.fold_requests(done)
         attribution = requests_mod.fold_attribution(done)
+        kv_fold = None
+        if ledger is not None:
+            kv_fold = kv_mod.fold_ledger(
+                reserved_page_s=ledger.reserved_page_s,
+                written_page_s=ledger.written_page_s,
+                pages_peak=allocator.pages_peak,
+                pages_recycled=allocator.recycled,
+                request_records=done)
         summary = {
             "workload": "serve",
             "model": self.cfg.model,
@@ -708,6 +906,13 @@ class ServeEngine:
             "max_in_flight": self.cap,
             "kv_page_size": self.page_size,
             "kv_pages": self.num_pages,
+            # round 22 (obs.kv): pool geometry + the utilization ledger
+            "kv_layers": (self.family.num_layers
+                          if self.decode_mode else None),
+            "kv_pool_bytes": self.kv_pool_bytes,
+            "kv_scale_bytes": self.kv_scale_bytes,
+            "kv_pool": kv_fold,
+            **kv_mod.flatten_kv(kv_fold),
             "decode_attention": (self.decode_attention
                                  if self.decode_mode else None),
             "quant": self.quant,

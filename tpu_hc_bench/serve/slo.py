@@ -27,6 +27,7 @@ goodput/MFU/p50 account):
 
 from __future__ import annotations
 
+from tpu_hc_bench.obs import kv as kv_mod
 from tpu_hc_bench.obs import requests as requests_mod
 
 SERVE_SUMMARY_KIND = "serve_summary"
@@ -106,6 +107,13 @@ def fold_serve_records(records: list[dict]) -> dict | None:
             if isinstance(fold.get("slo"), dict) else None
         if slo_t:
             fold["slo"] = fold_burn_rate(reqs, slo_t)
+    # round 22 (obs.kv): the pool ledger recomputed from the stream so
+    # a run truncated before its summary still reports utilization — a
+    # pre-r22 stream folds to None and the keys stay absent, labeled
+    kvf = kv_mod.fold_kv(records)
+    if kvf is not None:
+        fold["kv_pool"] = kvf
+        fold.update(kv_mod.flatten_kv(kvf))
     if compiles:
         c = compiles[-1]
         fold.setdefault("post_warmup_compiles",
@@ -227,6 +235,9 @@ def slo_lines(fold: dict) -> list[str]:
     # round 20 (obs.requests): where the p99 lives
     lines.extend(requests_mod.attribution_lines(
         fold.get("attribution"), p99_e2e_ms=fold.get("p99_e2e_ms")))
+    # round 22 (obs.kv): utilization headline + honesty gap + the
+    # tail-cause split + configured pool geometry
+    lines.extend(kv_mod.kv_lines(fold))
     lines.extend(burn_lines(fold.get("slo")))
     if fold.get("wall_s") is not None:
         lines.append(
@@ -297,6 +308,9 @@ def serve_diff_lines(fold_a: dict | None, fold_b: dict | None) -> list[str]:
     # side normalizes to zero components, labeled, never a KeyError
     lines.extend(requests_mod.attribution_diff_lines(
         fold_a.get("attribution"), fold_b.get("attribution")))
+    # round 22: utilization / honesty-gap / tail-cause deltas — same
+    # absent-not-error seam for a pre-r22 side
+    lines.extend(kv_mod.kv_diff_lines(fold_a, fold_b))
     return lines
 
 
@@ -318,6 +332,18 @@ def watch_lines(records: list[dict]) -> list[str]:
             # live per-bucket occupancy column (round 20)
             lines.append("  bucket occ: " + "  ".join(
                 f"{k} {v:.0%}" for k, v in sorted(occ.items())))
+    pools = [r for r in records if r.get("kind") == kv_mod.KV_POOL_KIND]
+    if pools:
+        # live pool-occupancy column (round 22): reserved vs actually
+        # written right now, plus the running high-water
+        p = pools[-1]
+        res = int(p.get("pages_reserved") or 0)
+        wrt = int(p.get("pages_written") or 0)
+        lines.append(
+            f"  kv pool: {res} reserved / {wrt} written / "
+            f"{p.get('free_pages', '?')} free pages  "
+            f"peak {p.get('pages_peak', '?')}  "
+            f"recycled {p.get('pages_recycled', '?')}")
     if fold and "p99_e2e_ms" in fold and fold.get("completed"):
         lines.append(
             f"  {fold['completed']} done  p99 ttft "
