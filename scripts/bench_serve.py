@@ -37,6 +37,13 @@ the ``obs.kv`` ledger) — the baseline the on-demand-paging ROADMAP
 item must move — plus the per-request reservation gap, restated in
 wasted pool bytes at each arm's page cost.
 
+``--mode faults`` (round 23) is the overload-survival A/B: one warmed
+engine, one overload trace, one fixed fault schedule (NaN-poisoned
+requests + a sticky KV-pool squeeze), shedding+preemption+quarantine
+vs the no-degradation control.  Headline: served-within-SLO goodput —
+the degrading arm must answer MORE of the trace correctly within
+``--deadline_ms`` than the arm that heroically serves everything late.
+
 Every mode folds the per-arm KV-pool ledger (``kv_pool`` /
 ``kv_pool_util`` / ``kv_req_gap_frac``) into its arms.
 
@@ -455,6 +462,147 @@ def run_kv_ab(args) -> dict:
     }
 
 
+#: the round-23 fixed fault schedule: three poisoned requests spread
+#: through the trace; the pool squeeze lands just after traffic starts
+#: and is sized at run time so the squeezed pool still fits two
+#: residents (a deeper squeeze would stall the no-degradation control
+#: outright and the A/B would measure a crash, not a policy)
+FAULT_NAN_RIDS = (5, 11, 23)
+FAULT_SQUEEZE_T = 0.05
+
+
+def run_faults_ab(args) -> dict:
+    """The round-23 overload-survival A/B: ONE warmed engine, one
+    seeded overload trace (arrival rate far above service capacity),
+    one fixed fault schedule (NaN-poisoned requests + a sticky KV-pool
+    squeeze), TWO policy arms —
+
+    - ``control``: no degradation (``--shed=off``, ``--kv_preempt=off``)
+      — the pre-round-23 engine: poisoned requests serve garbage,
+      squeezed admission head-of-line blocks, every request is served
+      arbitrarily late.
+    - ``degrade``: ``--shed=deadline`` + ``--kv_preempt=on`` — expired
+      and hopeless requests are shed with a cause, poisoned requests
+      are quarantined, pool pressure preempts/requeues instead of
+      blocking.
+
+    The headline is served-within-SLO goodput: the fraction of the
+    offered trace answered CORRECTLY (known-poisoned rids never count —
+    the control serves them, but serves NaN garbage) within
+    ``--deadline_ms``.  Runs under VirtualClock so the artifact is a
+    deterministic property of the policies, not of host load."""
+    from tpu_hc_bench.obs import metrics as obs_metrics
+    from tpu_hc_bench.serve import cli as serve_cli
+    from tpu_hc_bench.serve import engine as engine_mod
+    from tpu_hc_bench.serve import faults as faults_mod
+
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    import tempfile
+
+    root = args.metrics_root or tempfile.mkdtemp(prefix="bench_faults_")
+    cfg = _build_cfg(args, slo_e2e_ms=args.deadline_ms)
+    engine, requests = serve_cli.build_engine_and_requests(cfg, log)
+    squeeze = max(0, engine.num_pages - 2 * engine.table_width)
+    spec = ",".join(
+        [f"nan_logits@{r}" for r in FAULT_NAN_RIDS
+         if r < args.num_requests]
+        + ([f"pool_squeeze@{FAULT_SQUEEZE_T}:{squeeze}"]
+           if squeeze else []))
+    vclock = {"prefill": 0.004, "decode": 0.003, "classify": 0.002}
+
+    arm_policies = {
+        "control": dict(shed="off", kv_preempt="off"),
+        "degrade": dict(shed="deadline", kv_preempt="on"),
+    }
+    arms: dict[str, dict] = {}
+    for arm, policy in arm_policies.items():
+        mdir = os.path.join(root, arm)
+        log(f"--- faults arm: {arm} ({spec}) ---")
+        writer = serve_cli.serve_writer(cfg, mdir)
+        fleet = None
+        try:
+            summary = engine.run(
+                requests, batching="continuous", writer=writer,
+                clock=engine_mod.VirtualClock(vclock),
+                faults=faults_mod.parse_serve_plan(spec),
+                deadline_ms=args.deadline_ms, **policy)
+        finally:
+            writer.close()
+        served_ok = 0
+        counts = {"request": 0, "shed": 0, "quarantine": 0}
+        with open(os.path.join(mdir, "metrics.jsonl")) as f:
+            for line in f:
+                rec = json.loads(line)
+                kind = rec.get("kind")
+                if kind in counts:
+                    counts[kind] += 1
+                if (kind == "request"
+                        and rec["id"] not in FAULT_NAN_RIDS
+                        and rec["e2e_ms"] <= args.deadline_ms):
+                    served_ok += 1
+        arms[arm] = {
+            **policy,
+            "served_within_slo": round(
+                served_ok / max(1, args.num_requests), 4),
+            "completed": summary["completed"],
+            "shed": counts["shed"],
+            "quarantined": counts["quarantine"],
+            "degrade": summary.get("degrade"),
+            "shed_frac": summary.get("shed_frac"),
+            "p99_e2e_ms": summary.get("p99_e2e_ms"),
+            "goodput": summary["goodput"],
+            "slo": summary.get("slo"),
+            "post_warmup_compiles": summary["post_warmup_compiles"],
+            "metrics_dir": mdir,
+        }
+
+    ctl, deg = arms["control"], arms["degrade"]
+    verdict = {
+        # the acceptance property: under the SAME overload + faults,
+        # degrading serves MORE of the trace correctly within SLO than
+        # heroically serving everything late (and some of it poisoned)
+        "degrade_beats_control_goodput": (
+            deg["served_within_slo"] > ctl["served_within_slo"]),
+        "served_within_slo_delta": round(
+            deg["served_within_slo"] - ctl["served_within_slo"], 4),
+        # every degraded exit carries a cause (folded by obs summarize)
+        "sheds_caused": deg["degrade"]["shed"],
+        "quarantined": deg["quarantined"],
+        "preempts": deg["degrade"]["preempts"],
+        "zero_post_warmup_compiles": (
+            ctl["post_warmup_compiles"] == 0
+            and deg["post_warmup_compiles"] == 0),
+        "compile_record": engine.compile_record,
+    }
+    manifest = obs_metrics.manifest_subset(
+        obs_metrics.run_manifest(cfg=cfg))
+    return {
+        "metric": f"{cfg.model}_serve_faults_goodput",
+        "value": deg["served_within_slo"],
+        "unit": "served_within_slo_frac",
+        "vs_baseline": round(
+            deg["served_within_slo"]
+            / max(ctl["served_within_slo"], 1e-9), 3),
+        "extra": {
+            "workload": "serve",
+            "mode": "faults",
+            "model": cfg.model,
+            "arrival_rate": cfg.arrival_rate,
+            "num_requests": args.num_requests,
+            "deadline_ms": args.deadline_ms,
+            "fault_spec": spec,
+            "decode_attention": cfg.decode_attention,
+            "quant": cfg.quant,
+            "goodput": deg["goodput"],
+            # the regress gate's direction-aware degradation metric
+            "shed_frac": deg["shed_frac"],
+            "arms": arms,
+            "verdict": verdict,
+        },
+        "manifest": manifest,
+    }
+
+
 def main() -> int:
     env = os.environ.get
     ap = argparse.ArgumentParser(description=__doc__)
@@ -471,14 +619,23 @@ def main() -> int:
     ap.add_argument("--kv_page_size", type=int, default=16)
     ap.add_argument("--max_prompt_len", type=int, default=32)
     ap.add_argument("--max_output_len", type=int, default=16)
-    ap.add_argument("--mode", choices=["batching", "decode", "kv"],
+    ap.add_argument("--mode", choices=["batching", "decode", "kv",
+                                       "faults"],
                     default=env("BENCH_MODE", "batching"),
                     help="batching: continuous-vs-static on one warmed "
                          "engine; decode: gather-vs-paged-vs-int8 "
                          "kernel arms, one engine each; kv: the "
                          "round-22 allocation-honesty A/B — "
                          "worst-case-reservation control vs int8_kv, "
-                         "headline = measured kv_pool_util")
+                         "headline = measured kv_pool_util; faults: "
+                         "the round-23 overload-survival A/B — "
+                         "shedding+preemption vs no degradation under "
+                         "one fault schedule, headline = served-"
+                         "within-SLO goodput")
+    ap.add_argument("--deadline_ms", type=float,
+                    default=float(env("BENCH_DEADLINE_MS", "150")),
+                    help="faults mode: the per-request e2e SLO the "
+                         "shed policy defends")
     ap.add_argument("--decode_attention",
                     choices=["gather", "paged"],
                     default=env("BENCH_DECODE_ATTENTION", "gather"),
@@ -502,8 +659,8 @@ def main() -> int:
                     help="also write the comparison JSON here")
     args = ap.parse_args()
 
-    result = {"decode": run_decode_ab, "kv": run_kv_ab}.get(
-        args.mode, run_ab)(args)
+    result = {"decode": run_decode_ab, "kv": run_kv_ab,
+              "faults": run_faults_ab}.get(args.mode, run_ab)(args)
     print(json.dumps(result, indent=1))
     if args.json:
         with open(args.json, "w") as f:
@@ -516,6 +673,9 @@ def main() -> int:
     elif args.mode == "kv":
         ok = (v["gap_measured"] and v["zero_post_warmup_compiles"]
               and v["all_completed"])
+    elif args.mode == "faults":
+        ok = (v["degrade_beats_control_goodput"]
+              and v["zero_post_warmup_compiles"])
     else:
         ok = (v["continuous_beats_static_p99"]
               and v["continuous_beats_static_goodput"]

@@ -1,0 +1,483 @@
+"""Serve-lane overload + failure survival (round 23).
+
+Every degradation path of the serving engine, on the session-scoped
+warmed ``moe_engine`` in VIRTUAL time — warmup is the lane's whole
+cost, so the policy arms (shed / preempt / quarantine / drain) all
+replay traces through the ONE engine, exactly like the faults A/B in
+``scripts/bench_serve.py --mode faults``.
+
+The load-bearing pins:
+
+- **the fault grammar is shared**: ``--serve_faults`` parses through
+  ``inject.split_entries`` and a malformed entry names BOTH lanes'
+  vocabularies — one error message, two grammars;
+- **requeue loses nothing**: a preempted-and-requeued request finishes
+  with the exact token sequence of its unfaulted run, and its
+  component attribution still sums to ``e2e_ms`` across residencies;
+- **drain is exactly-once**: SIGTERM journals every unfinished
+  request and ``--serve_resume`` serves each journaled rid exactly
+  once — no request vanishes, none is served twice;
+- **degradation is visible**: causes land in ``obs summarize`` and
+  ``slo_lines``, the new spans are registered vocabulary, and
+  ``obs regress`` gates ``shed_frac`` direction-aware.
+
+The subprocess SIGTERM-mid-traffic e2e (real signal, real exit code
+75, real journal on disk) is slow-marked like the other CLI e2es.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_hc_bench import flags, resilience
+from tpu_hc_bench.analysis import lints
+from tpu_hc_bench.obs import kv as kv_mod
+from tpu_hc_bench.obs import metrics as obs_metrics
+from tpu_hc_bench.obs import regress, timeline
+from tpu_hc_bench.obs import requests as requests_mod
+from tpu_hc_bench.resilience import inject as inject_mod
+from tpu_hc_bench.serve import engine as engine_mod
+from tpu_hc_bench.serve import faults as faults_mod
+from tpu_hc_bench.serve import slo
+
+from conftest import SERVE_VCOSTS as VCOSTS  # noqa: E402
+
+
+def _quiet(_msg):
+    pass
+
+
+def _burst(requests):
+    """The trace with every arrival at t=0 — the only way a 2-slot
+    engine ever sees admission pressure in virtual time."""
+    return [dataclasses.replace(r, arrival_s=0.0) for r in requests]
+
+
+def _records(mdir, kinds=("request",)):
+    out = []
+    with open(os.path.join(mdir, obs_metrics.METRICS_NAME)) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") in kinds:
+                out.append(rec)
+    return out
+
+
+def _writer(mdir, cfg):
+    return obs_metrics.MetricsWriter(
+        str(mdir), obs_metrics.run_manifest(
+            cfg=cfg, extra={"workload": "serve"}))
+
+
+# --- the shared fault grammar -----------------------------------------
+
+
+def test_parse_serve_plan_grammar():
+    plan = faults_mod.parse_serve_plan(
+        "hang@2:0.5,nan_logits@3,sigterm@0.1,"
+        "pool_squeeze@0:2,pool_squeeze@0.2:1")
+    assert plan.hang == {2: 0.5}
+    assert plan.nan_logits == frozenset({3})
+    assert plan.sigterm == (0.1,)
+    assert plan.pool_squeeze == ((0.0, 2), (0.2, 1))
+    assert bool(plan)
+    assert faults_mod.parse_serve_plan(None) is None
+    assert faults_mod.parse_serve_plan("") is None
+
+
+@pytest.mark.parametrize("bad", [
+    "hang@2",            # hang needs seconds
+    "nan_logits@3:1",    # nan_logits takes no arg
+    "sigterm@-1",        # negative time
+    "pool_squeeze@0:0",  # zero pages squeezes nothing
+    "nan_loss@2",        # the TRAIN class, given to the serve lane
+    "what@ever:x",
+])
+def test_parse_serve_plan_loud_names_both_vocabularies(bad):
+    with pytest.raises(ValueError, match="malformed") as ei:
+        faults_mod.parse_serve_plan(bad)
+    # the ONE error message names both lanes' grammars (inject.malformed)
+    msg = str(ei.value)
+    assert "--inject_fault" in msg and "--serve_faults" in msg
+    assert "serve lane" in msg
+
+
+def test_serve_plan_hooks_are_one_shot():
+    plan = faults_mod.parse_serve_plan(
+        "hang@2:0.5,nan_logits@3,sigterm@0.1,pool_squeeze@0.2:2")
+    assert plan.hang_before_decode(1) == 0.0
+    assert plan.hang_before_decode(2) == 0.5
+    assert plan.hang_before_decode(2) == 0.0          # consumed
+    assert plan.poison_rids([1, 3, 5]) == [3]
+    assert plan.poison_rids([1, 3, 5]) == []          # consumed
+    assert not plan.sigterm_due(0.05)
+    assert plan.sigterm_due(0.2)
+    assert not plan.sigterm_due(0.2)                  # consumed
+    assert plan.squeezed_pages(0.1) == 0
+    assert plan.squeezed_pages(0.3) == 2
+    assert plan.squeezed_pages(9.9) == 2              # sticky, not one-shot
+
+
+def test_split_entries_shared_between_lanes():
+    # the serve grammar rides the train lane's splitter — structural
+    # malformation is one code path for both vocabularies
+    assert inject_mod.split_entries("hang@3:0.5", lane="serve") == \
+        [("hang", "3", "0.5", "hang@3:0.5")]
+    assert inject_mod.parse_plan("nan_loss@2") is not None  # train intact
+    with pytest.raises(ValueError, match="malformed"):
+        inject_mod.split_entries("noat", lane="serve")
+
+
+def test_flags_validate_degradation_knobs():
+    base = dict(model="moe_tiny", workload="serve", num_requests=4)
+    with pytest.raises(ValueError, match="deadline"):
+        flags.BenchmarkConfig(shed="deadline", **base).resolve()
+    with pytest.raises(ValueError, match="off|admit|deadline"):
+        flags.BenchmarkConfig(shed="yes", deadline_ms=50, **base).resolve()
+    with pytest.raises(ValueError, match="malformed"):
+        flags.BenchmarkConfig(serve_faults="hang@2", **base).resolve()
+    # the knobs are serve-only: the training lane rejects them loudly
+    with pytest.raises(ValueError):
+        flags.BenchmarkConfig(model="trivial", shed="deadline",
+                              deadline_ms=50).resolve()
+    # slo_e2e_ms is the documented deadline fallback
+    cfg = flags.BenchmarkConfig(shed="deadline", slo_e2e_ms=100.0,
+                                **base).resolve()
+    assert cfg.shed == "deadline"
+
+
+# --- quarantine -------------------------------------------------------
+
+
+def test_nan_quarantine_retires_only_poisoned_request(
+        moe_engine, moe_requests, tmp_path):
+    w = _writer(tmp_path / "m", moe_engine.cfg)
+    try:
+        summary = moe_engine.run(
+            moe_requests, batching="continuous", writer=w,
+            clock=engine_mod.VirtualClock(VCOSTS),
+            faults=faults_mod.parse_serve_plan("nan_logits@3"),
+            kv_preempt="on")      # arms the logits guard
+    finally:
+        w.close()
+    assert summary["completed"] == len(moe_requests) - 1
+    assert summary["degrade"]["quarantined"] == 1
+    assert summary["post_warmup_compiles"] == 0
+    q = _records(str(tmp_path / "m"), kinds=("quarantine",))
+    assert [r["id"] for r in q] == [3]
+    assert q[0]["status"] == "quarantined"
+    assert q[0]["cause"] == "nonfinite_logits"
+    # percentile folds fold kind=="request" only: the poisoned rid
+    # must not appear there
+    assert 3 not in {r["id"] for r in _records(str(tmp_path / "m"))}
+
+
+def test_unarmed_control_lets_nan_flow_through(moe_engine, moe_requests):
+    # the faults A/B's control arm: both policy knobs off means no
+    # host read-back, so the injected NaN decodes through undetected
+    summary = moe_engine.run(
+        moe_requests, batching="continuous",
+        clock=engine_mod.VirtualClock(VCOSTS),
+        faults=faults_mod.parse_serve_plan("nan_logits@3"),
+        shed="off", kv_preempt="off")
+    assert summary["completed"] == len(moe_requests)
+    assert summary["degrade"]["quarantined"] == 0
+
+
+# --- KV-pressure preemption / requeue ---------------------------------
+
+
+def test_requeue_conserves_tokens_and_components(
+        moe_engine, moe_requests, tmp_path):
+    burst = _burst(moe_requests)
+    # the unfaulted run's tokens, from a metrics stream (summaries
+    # carry counts, not records)
+    wb = _writer(tmp_path / "base", moe_engine.cfg)
+    try:
+        moe_engine.run(burst, batching="continuous", writer=wb,
+                       clock=engine_mod.VirtualClock(VCOSTS))
+    finally:
+        wb.close()
+    base_tokens = {r["id"]: r["generated"]
+                   for r in _records(str(tmp_path / "base"))}
+    w = _writer(tmp_path / "m", moe_engine.cfg)
+    try:
+        summary = moe_engine.run(
+            burst, batching="continuous", writer=w,
+            clock=engine_mod.VirtualClock(VCOSTS),
+            faults=faults_mod.parse_serve_plan("pool_squeeze@0:3"),
+            kv_preempt="on")
+    finally:
+        w.close()
+    assert summary["completed"] == len(burst)
+    assert summary["degrade"]["preempts"] >= 1
+    assert summary["degrade"]["requeues"] >= 1
+    assert summary["post_warmup_compiles"] == 0      # requeue re-prefills
+    recs = _records(str(tmp_path / "m"))
+    requeued = [r for r in recs if r.get("preempts")]
+    assert requeued, "squeeze + burst must preempt at least one resident"
+    for rec in recs:
+        # no token lost across residencies: the prefix carry re-prefills
+        # prompt+prefix, so generated output matches the unfaulted run
+        assert rec["generated"] == base_tokens[rec["id"]]
+        # and the lifecycle attribution still tiles e2e exactly
+        parts = requests_mod.attribution_of(rec)
+        assert abs(sum(parts.values()) - rec["e2e_ms"]) < 1e-6
+    events = _records(str(tmp_path / "m"), kinds=("preempt",))
+    assert events and all(e["cause"] == "pool_starved" for e in events)
+
+
+# --- shedding ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def shed_run(moe_engine, moe_requests, tmp_path_factory):
+    """ONE run under a terminal pool squeeze with ``--shed=deadline``:
+    nothing can ever admit, so every request must exit as a shed —
+    the would-stall-forever trace the shed path exists for."""
+    mdir = str(tmp_path_factory.mktemp("shed") / "m")
+    squeeze = moe_engine.num_pages - moe_engine.table_width + 1
+    w = _writer(mdir, moe_engine.cfg)
+    try:
+        summary = moe_engine.run(
+            _burst(moe_requests), batching="continuous", writer=w,
+            clock=engine_mod.VirtualClock(VCOSTS),
+            faults=faults_mod.parse_serve_plan(f"pool_squeeze@0:{squeeze}"),
+            shed="deadline", deadline_ms=100.0)
+    finally:
+        w.close()
+    return {"summary": summary, "mdir": mdir}
+
+
+def test_terminal_squeeze_sheds_instead_of_stalling(
+        shed_run, moe_engine, moe_requests):
+    summary = shed_run["summary"]
+    deg = summary["degrade"]
+    n = len(moe_requests)
+    assert summary["completed"] + sum(deg["shed"].values()) == n
+    assert deg["shed"].get("deadline_expired", 0) >= 1
+    assert 0.0 < summary["shed_frac"] <= 1.0
+    assert set(deg["shed"]) <= set(kv_mod.SHED_CAUSES)
+    recs = _records(shed_run["mdir"], kinds=("shed",))
+    assert all(r["status"] == "shed" and r["cause"] in kv_mod.SHED_CAUSES
+               for r in recs)
+    # the same trace with shedding off is a loud stall, not a hang
+    squeeze = moe_engine.num_pages - moe_engine.table_width + 1
+    with pytest.raises(RuntimeError, match="stall"):
+        moe_engine.run(
+            _burst(moe_requests), batching="continuous",
+            clock=engine_mod.VirtualClock(VCOSTS),
+            faults=faults_mod.parse_serve_plan(f"pool_squeeze@0:{squeeze}"),
+            shed="off")
+
+
+def test_slo_lines_render_degradation(shed_run):
+    lines = slo.slo_lines(shed_run["summary"])
+    deg_lines = [ln for ln in lines if "degrade:" in ln]
+    assert len(deg_lines) == 1
+    assert "shed" in deg_lines[0]
+    assert "deadline_expired" in deg_lines[0]
+    # a clean summary renders no degrade line at all
+    clean = dict(shed_run["summary"])
+    clean["degrade"] = {"shed": {}, "preempts": 0, "requeues": 0,
+                        "quarantined": 0}
+    assert not [ln for ln in slo.slo_lines(clean) if "degrade:" in ln]
+
+
+def test_obs_summarize_shows_shed_causes(shed_run):
+    lines = obs_metrics.summarize_run(shed_run["mdir"])
+    text = "\n".join(lines)
+    assert "shed" in text
+    assert "deadline_expired" in text
+
+
+def test_resilience_kinds_cover_degradation():
+    assert {"shed", "quarantine"} <= set(obs_metrics.RESILIENCE_KINDS)
+
+
+# --- drain / journal / resume ----------------------------------------
+
+
+class FakeHandler:
+    """Poll-a-fake drain trigger: ``requested()`` flips true after N
+    scheduler iterations — the in-process stand-in for SIGTERM."""
+
+    def __init__(self, after: int):
+        self.after = after
+        self.polls = 0
+
+    def requested(self) -> bool:
+        self.polls += 1
+        return self.polls > self.after
+
+
+def test_drain_journals_then_resume_serves_exactly_once(
+        moe_engine, moe_requests, tmp_path):
+    journal = str(tmp_path / "j" / "serve_journal.json")
+    w1 = _writer(tmp_path / "m1", moe_engine.cfg)
+    try:
+        summary = moe_engine.run(
+            moe_requests, batching="continuous", writer=w1,
+            clock=engine_mod.VirtualClock(VCOSTS),
+            drain_handler=FakeHandler(after=2), journal_path=journal)
+    finally:
+        w1.close()
+    drained = summary["drained"]
+    assert drained["reason"] == "sigterm"
+    assert drained["journal"] == journal
+    assert drained["unfinished"] >= 1
+    assert summary["completed"] + drained["unfinished"] == len(moe_requests)
+    payload = faults_mod.read_journal(journal)
+    replay = faults_mod.journal_requests(payload)
+    assert len(replay) == drained["unfinished"]
+    # the resumed run serves every journaled rid exactly once
+    w2 = _writer(tmp_path / "m2", moe_engine.cfg)
+    try:
+        resumed = moe_engine.run(replay, batching="continuous", writer=w2,
+                                 clock=engine_mod.VirtualClock(VCOSTS))
+    finally:
+        w2.close()
+    assert resumed["completed"] == len(replay)
+    first = {r["id"] for r in _records(str(tmp_path / "m1"))}
+    second = {r["id"] for r in _records(str(tmp_path / "m2"))}
+    assert first.isdisjoint(second)
+    assert first | second == {r.rid for r in moe_requests}
+
+
+def test_read_journal_loud_on_wrong_file(tmp_path):
+    p = tmp_path / "not_a_journal.json"
+    p.write_text('{"kind": "manifest"}\n')
+    with pytest.raises(ValueError, match="serve drain journal"):
+        faults_mod.read_journal(str(p))
+    with pytest.raises(FileNotFoundError):
+        faults_mod.read_journal(str(tmp_path / "missing.json"))
+
+
+# --- scheduler watchdog ----------------------------------------------
+
+
+def test_watchdog_hook_fires_on_wedged_iteration(moe_engine, moe_requests):
+    fired: list = []
+    # real clock on purpose: hang@2 is a real 0.8s stall, which the
+    # 0.3s watchdog must catch; on_watchdog replaces os._exit so the
+    # run survives for the assertion
+    summary = moe_engine.run(
+        moe_requests, batching="continuous",
+        faults=faults_mod.parse_serve_plan("hang@2:0.8"),
+        step_timeout_s="0.3",
+        on_watchdog=lambda age: fired.append(age))
+    assert fired and fired[0] >= 0.3
+    assert summary["completed"] == len(moe_requests)
+
+
+def test_watchdog_quiet_on_healthy_run(moe_engine, moe_requests):
+    fired: list = []
+    summary = moe_engine.run(
+        moe_requests, batching="continuous",
+        step_timeout_s="30",
+        on_watchdog=lambda age: fired.append(age))
+    assert not fired
+    assert summary["completed"] == len(moe_requests)
+
+
+# --- obs vocabulary + regress gate ------------------------------------
+
+
+def test_degradation_spans_are_registered_vocabulary():
+    assert {"shed", "preempt", "requeue", "quarantine", "drain"} \
+        <= set(timeline.KNOWN_SPANS)
+
+
+def test_regress_gates_shed_frac_direction_aware():
+    assert (("extra", "shed_frac"), "lower", "shed frac") in regress.CHECKS
+    assert regress.ABS_FLOORS["shed frac"] == 0.05
+
+
+# --- retire-without-status lint ---------------------------------------
+
+
+BAD_RETIRE = """
+class E:
+    def run(self):
+        self.finish(fl, t)
+        shed_queued(req, t)
+"""
+
+GOOD_RETIRE = """
+class E:
+    def run(self):
+        self.finish(fl, t, status="ok")
+        finish(fl, t, status="shed", cause="resident_expired")
+        shed_queued(req, "deadline_expired", t)
+"""
+
+
+def test_retire_status_lint_flags_statusless_terminals():
+    found = [f for f in lints.lint_source_text(
+        BAD_RETIRE, filename="tpu_hc_bench/serve/engine.py")
+        if f.lint == lints.RETIRE_STATUS]
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+    # not this lint's business outside the serve package
+    assert not [f for f in lints.lint_source_text(
+        BAD_RETIRE, filename="tpu_hc_bench/train/driver.py")
+        if f.lint == lints.RETIRE_STATUS]
+
+
+def test_retire_status_lint_passes_disposed_terminals():
+    assert not [f for f in lints.lint_source_text(
+        GOOD_RETIRE, filename="tpu_hc_bench/serve/engine.py")
+        if f.lint == lints.RETIRE_STATUS]
+
+
+def test_retire_status_lint_registered():
+    from tpu_hc_bench.analysis import registry
+    assert lints.RETIRE_STATUS in {row[0] for row in registry.pass_index()}
+    assert registry.default_severity(lints.RETIRE_STATUS) == "error"
+
+
+# --- subprocess e2e: SIGTERM mid-traffic, exit 75, resume -------------
+
+
+@pytest.mark.slow
+def test_serve_sigterm_drain_resume_subprocess(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    journal = str(tmp_path / "serve_journal.json")
+    base = [sys.executable, "-m", "tpu_hc_bench", "serve",
+            "--model", "moe_tiny", "--arrival_rate", "50",
+            "--num_requests", "8", "--max_prompt_len", "8",
+            "--max_output_len", "4", "--max_in_flight", "2",
+            "--kv_page_size", "4"]
+    m1, m2 = str(tmp_path / "m1"), str(tmp_path / "m2")
+    first = subprocess.run(
+        base + ["--metrics_dir", m1, "--serve_journal", journal,
+                "--serve_faults", "sigterm@0.05"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=570)
+    assert first.returncode == resilience.EXIT_PREEMPTED, \
+        f"stdout:\n{first.stdout}\nstderr:\n{first.stderr}"
+    assert "drain" in first.stdout
+    assert os.path.exists(journal)
+    payload = faults_mod.read_journal(journal)
+    assert payload["unfinished"] >= 1
+    second = subprocess.run(
+        base + ["--metrics_dir", m2, "--serve_resume", journal],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=570)
+    assert second.returncode == 0, \
+        f"stdout:\n{second.stdout}\nstderr:\n{second.stderr}"
+    assert "resume" in second.stdout
+    done1 = {r["id"] for r in _records(m1)}
+    done2 = {r["id"] for r in _records(m2)}
+    # exactly-once across the SIGTERM boundary: the two runs partition
+    # the trace, and the resumed records still attribute cleanly
+    assert done1.isdisjoint(done2)
+    assert done1 | done2 == set(range(8))
+    for rec in _records(m2):
+        parts = requests_mod.attribution_of(rec)
+        assert abs(sum(parts.values()) - rec["e2e_ms"]) < 1e-6

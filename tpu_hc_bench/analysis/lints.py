@@ -121,9 +121,10 @@ SPAN_IN_JIT = "span-in-compiled-fn"
 DEQUANT_HOT = "dequantize-in-hot-loop"
 FLEET_WAIT = "fleet-blocking-wait"
 SPAN_REGISTRY = "span-name-registry"
+RETIRE_STATUS = "retire-without-status"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
                     INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE, SPAN_IN_JIT,
-                    DEQUANT_HOT, FLEET_WAIT, SPAN_REGISTRY)
+                    DEQUANT_HOT, FLEET_WAIT, SPAN_REGISTRY, RETIRE_STATUS)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -998,6 +999,53 @@ class _FileLinter:
                 "pass a timeout (`.wait(grace_s)` / "
                 "`.join(timeout=...)`) or poll with a bounded sleep "
                 "like supervisor.reap")
+
+    # -- retire-without-status -----------------------------------------
+
+    # terminal call sites in the serve engine: every request leaving
+    # the ledger goes through one of these
+    _TERMINAL_CALLEES = {"finish", "shed_queued"}
+
+    @register_pass(
+        RETIRE_STATUS, "error", "file",
+        doc="a serve-engine terminal call site (finish/shed_queued) "
+            "without a status/cause stamp — a request would leave the "
+            "ledger uncaused",
+        example="`finish(fl, t_done)` with no `status=` keyword")
+    def _check_retire_status(self):
+        """**retire-without-status** (error, serve package only): a
+        ``finish(...)``/``shed_queued(...)`` call that stamps no
+        terminal disposition.
+
+        Round 23's degradation contract is that EVERY request leaving
+        the engine's ledger carries a terminal ``status`` (ok / shed /
+        quarantined) and, for degraded exits, a ``cause`` — `obs
+        summarize` and the faults A/B both fold on those stamps, so an
+        unstamped retire is a request that silently vanishes from the
+        degradation account.  A call passes when it spells a
+        ``status=``/``cause=`` keyword or passes the cause positionally
+        (three or more positional arguments); relying on the ``"ok"``
+        default is exactly the hazard — a later degraded caller copies
+        the spelling and mislabels a shed as served.
+        """
+        if not self._in_serve_package():
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_basename(node) not in self._TERMINAL_CALLEES:
+                continue
+            if len(node.args) >= 3 or any(
+                    kw.arg in ("status", "cause")
+                    for kw in node.keywords):
+                continue
+            name = _dotted(node.func) or _callee_basename(node)
+            self._emit(
+                RETIRE_STATUS, node,
+                f"`{name}(...)` retires a request with no terminal "
+                "status — stamp `status=` (and `cause=` for degraded "
+                "exits) so the ledger, `obs summarize`, and the faults "
+                "A/B agree on every request's disposition")
 
     # -- serve-bucket-recompile ----------------------------------------
 

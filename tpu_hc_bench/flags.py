@@ -94,6 +94,11 @@ SERVE_ONLY_FLAGS = (
     "max_in_flight", "kv_page_size", "kv_pages", "max_prompt_len",
     "max_output_len", "batching", "decode_attention", "quant",
     "decode_block_pages", "slo_e2e_ms",
+    # round 23: overload/failure survival — the serve lane's own
+    # spellings (the train lane's inject_fault/resume/step_timeout_s
+    # stay train-only; neither lane ever silently eats the other's)
+    "deadline_ms", "shed", "kv_preempt", "serve_faults",
+    "serve_journal", "serve_resume", "serve_step_timeout_s",
 )
 
 
@@ -589,6 +594,48 @@ class BenchmarkConfig:
                                               # summary distinguishes
                                               # sustained overload from a
                                               # transient burst (0 = off)
+    deadline_ms: float = 0.0                  # per-request service
+                                              # deadline (round 23): the
+                                              # shed policies measure
+                                              # "already dead" against it
+                                              # (0 = fall back to
+                                              # slo_e2e_ms)
+    shed: str = "off"                         # load shedding: off |
+                                              # admit (reject requests
+                                              # whose deadline already
+                                              # expired at admission) |
+                                              # deadline (admit + predict
+                                              # queue wait blowing the
+                                              # deadline, and retire
+                                              # already-expired residents
+                                              # instead of decoding dead
+                                              # tokens)
+    kv_preempt: str = "off"                   # KV-pressure preemption:
+                                              # when the pool cannot
+                                              # serve an admit, preempt
+                                              # the resident with most
+                                              # pages per token of
+                                              # progress, free its pages,
+                                              # requeue it carrying its
+                                              # generated prefix (off |
+                                              # on)
+    serve_faults: str | None = None           # deterministic serve-lane
+                                              # fault injection:
+                                              # hang@STEP:S,
+                                              # nan_logits@RID,
+                                              # sigterm@T,
+                                              # pool_squeeze@T:PAGES
+    serve_journal: str | None = None          # drain journal path
+                                              # (default:
+                                              # <metrics_dir>/
+                                              # serve_journal.json)
+    serve_resume: str | None = None           # replay every unfinished
+                                              # request from a drain
+                                              # journal exactly once
+    serve_step_timeout_s: str | None = None   # scheduler-iteration
+                                              # watchdog: no iteration
+                                              # within this -> timeline/
+                                              # memory dumps + exit 70
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -696,6 +743,30 @@ class BenchmarkConfig:
             raise ValueError(
                 f"--slo_e2e_ms must be >= 0 ms (0 = no SLO tracking): "
                 f"{self.slo_e2e_ms}")
+        # round 23: the degradation/survival knobs
+        if self.deadline_ms < 0:
+            raise ValueError(
+                f"--deadline_ms must be >= 0 ms (0 = use --slo_e2e_ms): "
+                f"{self.deadline_ms}")
+        if self.shed not in ("off", "admit", "deadline"):
+            raise ValueError(
+                f"--shed must be off|admit|deadline: {self.shed!r}")
+        if self.shed != "off" and not (self.deadline_ms
+                                       or self.slo_e2e_ms):
+            raise ValueError(
+                "--shed needs a deadline to shed against: set "
+                "--deadline_ms (or --slo_e2e_ms, its fallback)")
+        if self.kv_preempt not in ("off", "on"):
+            raise ValueError(
+                f"--kv_preempt must be off|on: {self.kv_preempt!r}")
+        if self.serve_faults:
+            from tpu_hc_bench.serve.faults import parse_serve_plan
+
+            parse_serve_plan(self.serve_faults)     # loud format check
+        if self.serve_step_timeout_s is not None:
+            from tpu_hc_bench.resilience.watchdog import resolve_timeout
+
+            resolve_timeout(self.serve_step_timeout_s)  # loud check
         # loud format checks (raise on malformed spec; values re-read by
         # the engine)
         parse_serve_buckets(self.serve_buckets, self.max_in_flight)
@@ -1131,6 +1202,19 @@ class BenchmarkConfig:
                 + (f" decode_block_pages={self.decode_block_pages}"
                    if self.decode_block_pages else ""),
             ]
+            if (self.shed != "off" or self.kv_preempt != "off"
+                    or self.serve_faults or self.serve_resume
+                    or self.serve_step_timeout_s):
+                lines.append(
+                    f"shed={self.shed} kv_preempt={self.kv_preempt}"
+                    + (f" deadline_ms={self.deadline_ms:g}"
+                       if self.deadline_ms else "")
+                    + (f" faults={self.serve_faults}"
+                       if self.serve_faults else "")
+                    + (f" resume={self.serve_resume}"
+                       if self.serve_resume else "")
+                    + (f" watchdog={self.serve_step_timeout_s}s"
+                       if self.serve_step_timeout_s else ""))
             for k, v in self.translations.items():
                 lines.append(f"translated: {k}: {v}")
             return lines
@@ -1308,6 +1392,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--decode_block_pages", type=int,
                    default=d.decode_block_pages)
     p.add_argument("--slo_e2e_ms", type=float, default=d.slo_e2e_ms)
+    # --- round 23: overload/failure survival knobs ---
+    p.add_argument("--deadline_ms", type=float, default=d.deadline_ms)
+    p.add_argument("--shed", type=str, default=d.shed,
+                   choices=["off", "admit", "deadline"])
+    p.add_argument("--kv_preempt", type=str, default=d.kv_preempt,
+                   choices=["off", "on"])
+    p.add_argument("--serve_faults", type=str, default=None,
+                   metavar="hang@N:S,nan_logits@RID,sigterm@T,"
+                           "pool_squeeze@T:PAGES")
+    p.add_argument("--serve_journal", type=str, default=None,
+                   metavar="PATH")
+    p.add_argument("--serve_resume", type=str, default=None,
+                   metavar="JOURNAL")
+    p.add_argument("--serve_step_timeout_s", type=str, default=None,
+                   metavar="SECONDS")
     return p
 
 

@@ -54,6 +54,12 @@ CAUSE_KEYS = (
     ("batch_full", "queue_batch_full_ms"),
 )
 
+#: shed causes, in render order (round 23): the deadline-aware
+#: degradation stamps extending the r22 queue-wait split — every
+#: rejected or expired request names the policy decision that shed it
+SHED_CAUSES = ("deadline_expired", "deadline_predicted",
+               "resident_expired")
+
 
 def footprint_of(record: dict) -> dict | None:
     """One request record's KV footprint, or ``None`` when the record

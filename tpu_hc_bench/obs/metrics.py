@@ -366,7 +366,14 @@ def read_run(path: str,
 RESILIENCE_KINDS = (
     "injected_fault", "nonfinite_skip", "nonfinite_abort", "rewind",
     "emergency_ckpt", "preempt", "watchdog_dump", "io_retry",
+    # round 23, serve lane: every shed and quarantined request is a
+    # resilience event with a cause — degraded service must be visible
+    "shed", "quarantine",
 )
+
+#: per-kind cap on detail lines in summarize (an overload run sheds
+#: hundreds of requests; the counts line carries the totals)
+_RESILIENCE_DETAIL_CAP = 6
 
 
 def _of_kind(records: list[dict], kind: str) -> list[dict]:
@@ -507,10 +514,18 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
             counts[r["kind"]] = counts.get(r["kind"], 0) + 1
         lines.append("  resilience: " + "  ".join(
             f"{k}x{counts[k]}" for k in RESILIENCE_KINDS if k in counts))
+        shown: dict[str, int] = {}
         for r in res:
+            shown[r["kind"]] = shown.get(r["kind"], 0) + 1
+            if shown[r["kind"]] > _RESILIENCE_DETAIL_CAP:
+                continue
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k != "kind")
             lines.append(f"    {r['kind']}: {detail}")
+        for kind, n in shown.items():
+            if n > _RESILIENCE_DETAIL_CAP:
+                lines.append(f"    {kind}: ... "
+                             f"+{n - _RESILIENCE_DETAIL_CAP} more")
     tb = _last(records, "trace_buckets")
     if tb and tb.get("buckets"):
         total = sum(tb["buckets"].values()) or 1.0

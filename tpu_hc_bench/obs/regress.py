@@ -66,6 +66,11 @@ CHECKS = (
     # and the pool wastes more of its bytes; pre-r22 serve history
     # lacks the field and the check skips (never KeyError)
     (("extra", "kv_pool_util"), "higher", "kv pool util"),
+    # round 23: overload degradation — the fraction of the trace shed
+    # by deadline policy.  A RISE means the engine keeps capacity by
+    # refusing more work (capacity regression or an over-eager shed
+    # heuristic); pre-r23 history lacks the field and the check skips
+    (("extra", "shed_frac"), "lower", "shed frac"),
 )
 
 #: identity fields folded into the fingerprint (record path order)
@@ -101,6 +106,8 @@ ABS_FLOORS = {
     "tail decode_stall frac": 0.05,
     # round 22: utilization is a fraction with the same jitter shape
     "kv pool util": 0.05,
+    # round 23: shed fraction is 0.0 in any well-provisioned history
+    "shed frac": 0.05,
 }
 
 

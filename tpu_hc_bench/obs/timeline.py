@@ -75,6 +75,10 @@ KNOWN_SPANS = frozenset((
     # serve admission forensics (round 22): edge-triggered instants the
     # moment the queue blocks on a resource
     "pool_starved", "batch_full",
+    # serve degradation (round 23): every load-shed, KV-pressure
+    # preemption/requeue, poisoned-request quarantine, and SIGTERM
+    # drain leaves an instant — failure forensics read the timeline
+    "shed", "preempt", "requeue", "quarantine", "drain",
     # checkpoint
     "ckpt_snapshot", "ckpt_write", "ckpt_restore",
 )) | _PHASE_LANE_NAMES

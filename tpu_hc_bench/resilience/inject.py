@@ -28,10 +28,48 @@ import os
 import signal
 import time
 
+#: the two lanes' fault vocabularies — one home, so a malformed entry
+#: in EITHER lane names both grammars instead of leaving the user to
+#: guess which spelling belongs to which flag
+TRAIN_VOCAB = "nan_loss@STEP | hang@STEP:SECONDS | sigterm@STEP | io_error@ckpt"
+SERVE_VOCAB = ("hang@STEP:SECONDS | nan_logits@RID | sigterm@T_SECONDS"
+               " | pool_squeeze@T_SECONDS:PAGES")
+
 _USAGE = (
     "--inject_fault grammar: comma-separated entries of "
-    "nan_loss@STEP | hang@STEP:SECONDS | sigterm@STEP | io_error@ckpt"
+    + TRAIN_VOCAB
 )
+
+
+def malformed(entry: str, lane: str = "train") -> str:
+    """The ONE parse-error message both lanes raise: names the entry,
+    the lane it was given to, and BOTH vocabularies (the most common
+    mistake is a valid spelling handed to the wrong flag)."""
+    return (f"malformed fault entry {entry!r} for the {lane} lane; "
+            f"train grammar (--inject_fault): {TRAIN_VOCAB}; "
+            f"serve grammar (--serve_faults): {SERVE_VOCAB}")
+
+
+def split_entries(spec: str | None,
+                  lane: str = "train") -> list[tuple]:
+    """Shared ``CLASS@WHERE[:ARG]`` splitter for both lanes' fault
+    grammars: comma-separated entries -> ``(cls, where, arg, entry)``
+    tuples (``arg`` is None when no ``:`` part), loud on structural
+    malformation.  Class/argument *semantics* stay with each lane's
+    parser (``parse_plan`` here, ``serve.faults.parse_serve_plan``)."""
+    out: list[tuple] = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        cls, sep, rest = entry.partition("@")
+        if not sep or not cls or not rest:
+            raise ValueError(malformed(entry, lane))
+        where, sep2, arg = rest.partition(":")
+        if not where or (sep2 and not arg):
+            raise ValueError(malformed(entry, lane))
+        out.append((cls, where, arg if sep2 else None, entry))
+    return out
 
 
 @dataclasses.dataclass
@@ -102,32 +140,28 @@ def parse_plan(spec: str | None) -> FaultPlan | None:
     hang: dict[int, float] = {}
     sigterm: set[int] = set()
     io_error: set[str] = set()
-    for entry in spec.split(","):
-        entry = entry.strip()
-        if not entry:
-            continue
-        cls, sep, arg = entry.partition("@")
-        if not sep or not arg:
-            raise ValueError(f"malformed entry {entry!r}; {_USAGE}")
+    for cls, where, arg, entry in split_entries(spec, lane="train"):
         try:
             if cls == "nan_loss":
-                nan_loss.add(_step(arg))
+                if arg is not None:
+                    raise ValueError
+                nan_loss.add(_step(where))
             elif cls == "hang":
-                at, sep2, secs = arg.partition(":")
-                if not sep2:
+                if arg is None:
                     raise ValueError
-                hang[_step(at)] = _seconds(secs)
+                hang[_step(where)] = _seconds(arg)
             elif cls == "sigterm":
-                sigterm.add(_step(arg))
-            elif cls == "io_error":
-                if arg != "ckpt":
+                if arg is not None:
                     raise ValueError
-                io_error.add(arg)
+                sigterm.add(_step(where))
+            elif cls == "io_error":
+                if where != "ckpt" or arg is not None:
+                    raise ValueError
+                io_error.add(where)
             else:
                 raise ValueError
         except ValueError:
-            raise ValueError(
-                f"malformed entry {entry!r}; {_USAGE}") from None
+            raise ValueError(malformed(entry, "train")) from None
     return FaultPlan(nan_loss=frozenset(nan_loss), hang=hang,
                      sigterm=frozenset(sigterm), io_error=io_error)
 

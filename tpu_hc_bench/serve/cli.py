@@ -6,8 +6,16 @@ rejects training-only knobs loudly), same observability contract
 (``--metrics_dir`` leaves manifest.json + metrics.jsonl and the banner
 prints the summarize command), same exit codes where they apply:
 
-- ``0`` clean (every request completed)
-- ``1`` run completed but zero requests finished
+- ``0``  clean (every request completed, shed, or quarantined)
+- ``1``  run completed but zero requests finished
+- ``70`` scheduler-iteration watchdog fired (``--serve_step_timeout_s``)
+- ``75`` SIGTERM/Ctrl-C honored: the engine drained, journaled every
+  unfinished request, and ``--serve_resume=<journal>`` replays them
+  exactly once
+
+On every exit path — including Ctrl-C — the metrics stream and the
+FleetWriter are flushed and closed, so the tail of an interrupted run
+is still on disk for ``obs summarize``.
 
 Example::
 
@@ -99,17 +107,37 @@ def main(argv: list[str] | None = None,
         print_fn(line)
 
     engine, requests = build_engine_and_requests(cfg, print_fn)
+    if cfg.serve_resume:
+        # drain-journal replay: serve every unfinished request of the
+        # SIGTERM'd run exactly once (the journal is the trace)
+        from tpu_hc_bench.serve import faults as faults_mod
+
+        payload = faults_mod.read_journal(cfg.serve_resume)
+        requests = faults_mod.journal_requests(payload)
+        print_fn(f"resume: {len(requests)} unfinished request(s) from "
+                 f"{cfg.serve_resume} (reason={payload.get('reason')})")
     writer = serve_writer(cfg, cfg.metrics_dir)
     if writer.enabled:
         print_fn(f"metrics: {cfg.metrics_dir}/{obs_metrics.METRICS_NAME} "
                  f"(+ {obs_metrics.MANIFEST_NAME}); live view: "
                  f"python -m tpu_hc_bench.obs watch {cfg.metrics_dir}")
-    summary = run_serve(engine, requests, writer)
+    try:
+        summary = run_serve(engine, requests, writer)
+    except KeyboardInterrupt:
+        # the engine's own handler converts SIGINT into a drain while
+        # run() is live; this catches a Ctrl-C outside that window —
+        # run_serve's finally already flushed and closed the streams
+        print_fn("interrupted — metrics stream closed")
+        return 130
     for line in slo_mod.slo_lines(summary):
         print_fn(line)
     if cfg.metrics_dir:
         print_fn("summarize: python -m tpu_hc_bench.obs summarize "
                  + cfg.metrics_dir)
+    if summary.get("drained"):
+        from tpu_hc_bench import resilience
+
+        return resilience.EXIT_PREEMPTED
     return 0 if summary["completed"] > 0 else 1
 
 

@@ -23,10 +23,20 @@ half).  Design constraints, in order:
    completion, only then admit again.
 3. **Paged KV cache** (vLLM): requests hold page tables into one
    shared pool, not max-length slabs.  Allocation is conservative —
-   a request's worst-case page count is reserved at admission, so
-   mid-generation eviction/preemption never happens (on-demand page
-   growth with preemption is the ROADMAP follow-up); the *layout* and
-   the compiled programs are fully paged.
+   a request's worst-case page count is reserved at admission — and
+   under ``--kv_preempt=on`` a starved admit preempts the resident
+   with the most pages per token of progress, frees its pages, and
+   requeues it carrying its generated prefix: re-admission re-prefills
+   prompt+prefix, so no token is lost across residencies (round 23;
+   the admission half of the ROADMAP on-demand-paging item).
+4. **Graceful degradation** (round 23): deadline-aware load shedding
+   (``--shed`` against ``--deadline_ms``), per-request quarantine of
+   non-finite logits, a SIGTERM drain that journals every unfinished
+   request for ``--serve_resume``, and a scheduler-iteration watchdog
+   (``--serve_step_timeout_s``) — overload and faults degrade the
+   answer set, never the process.  Every knob defaults off, and the
+   off path adds no host transfers: the determinism and zero-lowering
+   pins ride on an unarmed ``run()`` staying byte-identical.
 
 Timing goes through an injectable clock so tests drive the closed
 loop in virtual time (``VirtualClock``): real runs measure wall
@@ -38,6 +48,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import time
 from typing import Any, Callable
 
@@ -49,6 +60,9 @@ from tpu_hc_bench.obs import kv as kv_mod
 from tpu_hc_bench.obs import metrics as obs_metrics
 from tpu_hc_bench.obs import requests as requests_mod
 from tpu_hc_bench.obs import timeline as timeline_mod
+from tpu_hc_bench.resilience import preempt as preempt_mod
+from tpu_hc_bench.resilience import watchdog as watchdog_mod
+from tpu_hc_bench.serve import faults as faults_mod
 from tpu_hc_bench.serve import slo as slo_mod
 from tpu_hc_bench.serve.arrivals import Request
 
@@ -219,6 +233,13 @@ class _InFlight:
     # resident per step, well under the round-17 recorder guard
     active_s: float = 0.0
     t_last: float | None = None
+    # round 23 (KV-pressure preemption): completed residencies, and
+    # tokens produced in THIS residency — a re-admitted victim must
+    # earn one decode token before it is preemptible again, which is
+    # the whole livelock-freedom argument (every residency advances
+    # the request by >= 1 token)
+    preempts: int = 0
+    produced_res: int = 0
 
 
 class ServeEngine:
@@ -520,7 +541,10 @@ class ServeEngine:
 
     def run(self, requests: list[Request], batching: str | None = None,
             writer: obs_metrics.MetricsWriter | None = None,
-            clock=None, fleet=None) -> dict:
+            clock=None, fleet=None, *, faults=None, shed=None,
+            deadline_ms=None, kv_preempt=None, journal_path=None,
+            drain_handler=None, step_timeout_s=None,
+            on_watchdog=None) -> dict:
         """Play a request trace; returns the serve summary record.
 
         Deterministic given (engine seed, trace, clock): greedy decode,
@@ -532,11 +556,44 @@ class ServeEngine:
         heartbeats at the serve-record cadence with the pool high-water
         under ``kv_peak_pages``, so ``obs watch``'s fleet view shows
         per-host KV pressure the same way it shows ``mem_peak_bytes``.
+
+        The keyword-only degradation knobs (round 23) override their
+        config twins per run, so tests and the faults A/B drive policy
+        arms through ONE warmed engine — a second warmup per arm would
+        break the zero-compile contract.  A ``faults`` plan is
+        consumed as it fires (one-shot hooks): pass a fresh
+        ``faults.parse_serve_plan`` result per run.  ``drain_handler``
+        replaces the engine's own SIGTERM/SIGINT handler (tests poll a
+        fake); ``on_watchdog`` replaces the watchdog's ``os._exit``.
         """
         batching = batching or self.cfg.batching
         if batching not in ("continuous", "static"):
             raise ValueError(f"batching must be continuous|static: "
                              f"{batching!r}")
+        if faults is None and self.cfg.serve_faults:
+            faults = faults_mod.parse_serve_plan(self.cfg.serve_faults)
+        shed = shed if shed is not None else self.cfg.shed
+        kv_preempt = (kv_preempt if kv_preempt is not None
+                      else self.cfg.kv_preempt)
+        deadline_ms = (deadline_ms if deadline_ms is not None
+                       else (self.cfg.deadline_ms or self.cfg.slo_e2e_ms))
+        if shed not in ("off", "admit", "deadline"):
+            raise ValueError(f"shed must be off|admit|deadline: {shed!r}")
+        if shed != "off" and not deadline_ms:
+            raise ValueError(
+                "--shed needs a deadline to shed against: set "
+                "--deadline_ms (or --slo_e2e_ms, its fallback)")
+        deadline_s = (deadline_ms or 0.0) / 1e3
+        if not self.decode_mode and (faults or kv_preempt == "on"):
+            raise ValueError(
+                f"--model {self.cfg.model} serves single-forward "
+                "classify requests; --serve_faults/--kv_preempt drive "
+                "the paged decode path and have no meaning here")
+        # the quarantine guard arms with EITHER policy knob: reading
+        # logits back is one host transfer per step that the unarmed
+        # lane must not pay (an injected NaN with both knobs off flows
+        # through undetected — the faults A/B's control arm)
+        guard = shed != "off" or kv_preempt == "on"
         writer = writer or obs_metrics.MetricsWriter(None)
         # flight recorder: honor --flight_recorder and, on metrics runs,
         # persist this process's spans beside the stream
@@ -550,6 +607,18 @@ class ServeEngine:
         # queue-wait cause split (round 22): rid -> accumulated seconds
         # blocked on [pool_starved, batch_full] while sitting in queue
         wait_causes: dict[int, list[float]] = {}
+        # round 23 degradation state: terminal dispositions counted by
+        # cause, the preempted-victim carry (rid -> prefix + original
+        # lifecycle instants, so the conserved components span both
+        # residencies), and the admit-to-done EWMA the predictive shed
+        # judges against
+        degrade: dict = {"shed": {}, "preempts": 0, "requeues": 0,
+                         "quarantined": 0}
+        carry: dict[int, dict] = {}
+        finished = 0
+        service_ewma_s: float | None = None
+        squeezed_seen = 0
+        drained: dict | None = None
         pending = sorted(requests, key=lambda r: (r.arrival_s, r.rid))
         n = len(pending)
         if self.decode_mode:
@@ -594,9 +663,15 @@ class ServeEngine:
             u[2] += active_rows
             u[3] += dt
 
-        def finish(fl: _InFlight, t_done: float) -> None:
+        def finish(fl: _InFlight, t_done: float, status: str = "ok",
+                   cause: str | None = None) -> None:
+            nonlocal finished, service_ewma_s
+            finished += 1
             rec = {
                 "id": fl.req.rid,
+                # the terminal disposition every ledger exit stamps
+                # (the retire-without-status lint pins call sites)
+                "status": status,
                 "arrival_s": round(fl.req.arrival_s, 6),
                 "ttft_ms": round(
                     1e3 * ((fl.t_first if fl.t_first is not None
@@ -605,6 +680,10 @@ class ServeEngine:
                 "prompt_len": fl.req.prompt_len,
                 "output_len": fl.produced,
             }
+            if cause:
+                rec["cause"] = cause
+            if fl.preempts:
+                rec["preempts"] = fl.preempts
             # the conserved e2e decomposition (obs.requests): classify
             # members have no prompt pass, so their whole resident
             # window belongs to the decode lane (t_first := t_admit)
@@ -634,15 +713,159 @@ class ServeEngine:
                 rec["pages_reserved"] = len(fl.pages)
                 rec["pages_peak_used"] = final_pages
                 rec["pages_final"] = final_pages
-            done.append(rec)
-            writer.event("request", **rec)
+            if status == "ok":
+                if not fl.preempts:
+                    # the predictive-shed service estimate: first-admit
+                    # to done of NEVER-preempted requests only — a
+                    # requeued request's span includes its requeue wait,
+                    # and folding that in spirals the estimate up until
+                    # prediction sheds the whole queue
+                    svc = t_done - fl.t_admit
+                    service_ewma_s = (
+                        svc if service_ewma_s is None
+                        else 0.7 * service_ewma_s + 0.3 * svc)
+                done.append(rec)
+                writer.event("request", **rec)
+            elif status == "shed":
+                # degraded terminals land under their OWN record kind:
+                # the percentile/attribution folds read kind=="request"
+                # only, so a shed or quarantined request never skews
+                # the served-latency percentiles
+                degrade["shed"][cause] = degrade["shed"].get(cause, 0) + 1
+                writer.event("shed", **rec)
+                timeline_mod.instant("shed", rid=fl.req.rid, cause=cause)
+            else:
+                degrade["quarantined"] += 1
+                writer.event("quarantine", **rec)
+                timeline_mod.instant("quarantine", rid=fl.req.rid,
+                                     cause=cause)
             timeline_mod.instant("retire", rid=fl.req.rid)
             if allocator is not None:
                 allocator.free(fl.pages)
 
+        def shed_queued(req: Request, cause: str, t: float) -> None:
+            """Admit-time shed: the request never became resident, so
+            there is no _InFlight to finish — but the disposition is
+            terminal and carries its cause all the same."""
+            nonlocal finished
+            finished += 1
+            degrade["shed"][cause] = degrade["shed"].get(cause, 0) + 1
+            causes = wait_causes.pop(req.rid, None) or [0.0, 0.0]
+            c = carry.pop(req.rid, None)
+            rec = {
+                "id": req.rid, "status": "shed", "cause": cause,
+                "arrival_s": round(req.arrival_s, 6),
+                "waited_ms": round(1e3 * (t - req.arrival_s), 3),
+                "queue_pool_starved_ms": round(1e3 * causes[0], 3),
+                "queue_batch_full_ms": round(1e3 * causes[1], 3),
+            }
+            if c:
+                rec["preempts"] = c["preempts"]
+            writer.event("shed", **rec)
+            timeline_mod.instant("shed", rid=req.rid, cause=cause)
+
+        def free_now() -> int:
+            """Allocator free pages minus any injected pool squeeze —
+            the admission path's ONE view of pool headroom."""
+            f = allocator.free_pages
+            if faults is not None:
+                f -= faults.squeezed_pages(now())
+            return max(0, f)
+
+        def preempt_one() -> bool:
+            """KV pressure: preempt the resident holding the most pages
+            per token of progress and requeue it carrying its prefix.
+            Victims must (a) have produced 2**preempts tokens THIS
+            residency — a readmitted victim earns geometrically more
+            decode progress before it is preemptible again, so every
+            residency advances its request (no livelock) and the total
+            re-prefill overhead a request can accrue is bounded by a
+            constant factor of its output (no thrash under sustained
+            pool pressure) — and (b) re-prefill prompt+prefix inside
+            the warmed ladder (an off-ladder shape never runs).  With
+            a deadline armed, victims must additionally have burned
+            3/4 of their deadline: preempting a resident that can
+            still finish in time converts pool pressure into
+            re-prefill thrash AND a missed SLO, while one deep into
+            its budget is about to expire holding pages anyway."""
+            top = max(self.prefill_buckets)
+            t_now = now()
+            cands = [fl for fl in active
+                     if fl.produced_res >= (1 << fl.preempts)
+                     and fl.length <= top
+                     and (not deadline_s or shed == "off"
+                          or t_now - fl.req.arrival_s > 0.75 * deadline_s)]
+            if not cands:
+                return False
+            victim = max(cands, key=lambda fl: len(fl.pages)
+                         / max(1, fl.produced))
+            active.remove(victim)
+            ledger.retire(len(victim.pages), victim.length)
+            allocator.free(victim.pages)
+            carry[victim.req.rid] = {
+                "prefix": list(victim.out_tokens),
+                "t_admit": victim.t_admit, "t_first": victim.t_first,
+                "active_s": victim.active_s, "t_last": victim.t_last,
+                "preempts": victim.preempts + 1,
+            }
+            queue.append(victim.req)
+            degrade["preempts"] += 1
+            timeline_mod.instant("preempt", rid=victim.req.rid)
+            timeline_mod.instant("requeue", rid=victim.req.rid)
+            writer.event("preempt", rid=victim.req.rid,
+                         cause="pool_starved",
+                         pages_freed=len(victim.pages),
+                         produced=victim.produced)
+            return True
+
+        def drain(t: float) -> dict:
+            """SIGTERM drain: stop admitting, preempt every resident
+            into the journal, and commit queued + not-yet-arrived
+            requests with the checkpoint tmp->fsync->rename idiom —
+            the serving lane's emergency checkpoint."""
+            timeline_mod.instant("drain", queued=len(queue),
+                                 in_flight=len(active))
+            entries = []
+            for fl in list(active):
+                entries.append(faults_mod.journal_entry(
+                    fl.req, produced=fl.produced,
+                    prefix=list(fl.out_tokens),
+                    preempts=fl.preempts + 1))
+                if ledger is not None:
+                    ledger.retire(len(fl.pages), fl.length)
+                if allocator is not None:
+                    allocator.free(fl.pages)
+            active.clear()
+            for req in queue:
+                c = carry.pop(req.rid, None)
+                pfx = c["prefix"] if c else ()
+                entries.append(faults_mod.journal_entry(
+                    req, produced=len(pfx), prefix=list(pfx),
+                    preempts=c["preempts"] if c else 0))
+            queue.clear()
+            for req in pending[idx:]:
+                entries.append(faults_mod.journal_entry(req))
+            path = (journal_path or self.cfg.serve_journal
+                    or os.path.join(
+                        getattr(writer, "out_dir", None) or ".",
+                        faults_mod.JOURNAL_NAME))
+            faults_mod.write_journal(path, entries,
+                                     model=self.cfg.model,
+                                     seed=self.cfg.seed)
+            writer.event("preempt", scope="drain", cause="sigterm",
+                         t=round(t, 4), unfinished=len(entries),
+                         journal=path)
+            self.print_fn(
+                f"serve drain: {len(entries)} unfinished request(s) "
+                f"journaled to {path} — relaunch with "
+                f"--serve_resume={path} to replay them")
+            return {"journal": path, "unfinished": len(entries),
+                    "reason": "sigterm"}
+
         def admit(req: Request) -> None:
             nonlocal kv, tokens_out, productive_s
             t_admit = now()
+            c = carry.pop(req.rid, None)
             timeline_mod.instant("admit", rid=req.rid)
             if not self.decode_mode:
                 active.append(_InFlight(req=req, pages=[],
@@ -651,38 +874,87 @@ class ServeEngine:
                 return
             pages = allocator.alloc(self.table_width)
             assert pages is not None, "admission checked free_pages"
-            ledger.admit(len(pages), req.prompt_len)
             table = np.asarray(pages, np.int32)
-            s = pick_bucket(self.prefill_buckets, req.prompt_len)
+            prefix = c["prefix"] if c else []
+            if c:
+                # requeued victim: re-prefill prompt + generated prefix
+                # minus its newest token — the greedy pass regenerates
+                # that one (decode/prefill parity), so the request
+                # resumes exactly where preemption cut it, zero tokens
+                # lost and zero duplicated
+                feed = np.concatenate(
+                    [req.prompt, np.asarray(prefix[:-1], np.int32)])
+                degrade["requeues"] += 1
+            else:
+                feed = req.prompt
+            plen = int(len(feed))
+            ledger.admit(len(pages), plen)
+            s = pick_bucket(self.prefill_buckets, plen)
             toks = np.zeros((1, s), np.int32)
-            toks[0, :req.prompt_len] = req.prompt
-            (next_tok, _, kv), dt = self._timed(
+            toks[0, :plen] = feed
+            (next_tok, logits, kv), dt = self._timed(
                 clock, "prefill",
                 lambda: self.compiled[("prefill", s)](
                     self.exec_params, kv, toks,
-                    np.int32(req.prompt_len), table))
+                    np.int32(plen), table))
             # host-side numpy view BEFORE indexing: jax.Array.__getitem__
             # dispatches a jitted gather — a post-warmup compile the
             # zero-recompile contract (and the cache-entry assertion)
             # would catch
             next_tok = np.asarray(next_tok)
             steps["prefill"] += 1
-            tokens_out += 1
-            productive_s += dt * (req.prompt_len / s)
-            bucket_acct("prefill", s, req.prompt_len, dt)
+            if not c:
+                # a re-prefill regenerates an already-counted token
+                tokens_out += 1
+            productive_s += dt * (plen / s)
+            bucket_acct("prefill", s, plen, dt)
             ledger.charge(dt)
-            fl = _InFlight(req=req, pages=pages, table=table,
-                           length=req.prompt_len, produced=1,
-                           last_token=int(next_tok[0]), t_admit=t_admit,
-                           t_first=now(),
-                           out_tokens=[int(next_tok[0])])
-            if req.output_len <= 1:
-                finish(fl, now())
+            fl = _InFlight(
+                req=req, pages=pages, table=table, length=plen,
+                produced=(len(prefix) if c else 1),
+                last_token=int(next_tok[0]),
+                t_admit=(c["t_admit"] if c else t_admit),
+                t_first=(c["t_first"] if c else now()),
+                out_tokens=(list(prefix[:-1]) + [int(next_tok[0])]
+                            if c else [int(next_tok[0])]),
+                active_s=(c["active_s"] + dt if c else 0.0),
+                t_last=(c["t_last"] if c else None),
+                preempts=(c["preempts"] if c else 0),
+                produced_res=(0 if c else 1))
+            if guard:
+                row = np.asarray(logits)
+                if faults is not None and faults.poison_rids([req.rid]):
+                    row = np.full_like(np.array(row), np.nan)
+                    announce_nan(req.rid, "prefill")
+                if not np.isfinite(row).all():
+                    fl.t_last = now()
+                    finish(fl, now(), status="quarantined",
+                           cause="nonfinite_logits")
+                    return
+            if fl.produced >= req.output_len:
+                finish(fl, now(), status="ok")
             else:
                 active.append(fl)
 
+        def announce_nan(rid: int, where: str) -> None:
+            self.print_fn(f"inject: nan_logits rid {rid} ({where})")
+            writer.event("injected_fault", fault="nan_logits", rid=rid,
+                         where=where)
+
         def decode_step() -> None:
             nonlocal kv, tokens_out, productive_s
+            if faults is not None:
+                hang_s = faults.hang_before_decode(steps["decode"] + 1)
+                if hang_s:
+                    self.print_fn(f"inject: hang {hang_s}s before "
+                                  f"decode step {steps['decode'] + 1}")
+                    writer.event("injected_fault", fault="hang",
+                                 step=steps["decode"] + 1,
+                                 seconds=hang_s)
+                    # REAL wall, whatever the engine clock: the wedged-
+                    # host signature the watchdog's (real-time)
+                    # progress oracle exists to catch
+                    time.sleep(hang_s)
             b = pick_bucket(self.batch_buckets, len(active))
             toks = np.zeros((b,), np.int32)
             tables = np.zeros((b, self.table_width), np.int32)
@@ -693,7 +965,7 @@ class ServeEngine:
                 tables[i] = fl.table
                 lengths[i] = fl.length
                 mask[i] = True
-            (next_toks, _, kv), dt = self._timed(
+            (next_toks, logits, kv), dt = self._timed(
                 clock, "decode",
                 lambda: self.compiled[("decode", b)](
                     self.exec_params, kv, toks, tables, lengths, mask))
@@ -703,18 +975,41 @@ class ServeEngine:
             bucket_acct("decode", b, len(active), dt)
             ledger.charge(dt)
             next_toks = np.asarray(next_toks)
+            bad: set[int] = set()
+            if guard:
+                # per-request quarantine: ONE host read of the step's
+                # logits, rows checked independently — a poisoned
+                # request retires alone, batch-mates keep their
+                # (finite) tokens
+                lg = np.asarray(logits)[:len(active)]
+                hit = (set(faults.poison_rids(
+                    [fl.req.rid for fl in active]))
+                    if faults is not None else set())
+                if hit:
+                    lg = np.array(lg)   # writable copy to poison
+                    for i, fl in enumerate(active):
+                        if fl.req.rid in hit:
+                            lg[i] = np.nan
+                            announce_nan(fl.req.rid, "decode")
+                finite = np.isfinite(lg.reshape(len(lg), -1)).all(axis=1)
+                bad = {i for i in range(len(active)) if not finite[i]}
             t_done = now()
             still: list[_InFlight] = []
             for i, fl in enumerate(active):
+                fl.active_s += dt
+                fl.t_last = t_done
+                if i in bad:
+                    finish(fl, t_done, status="quarantined",
+                           cause="nonfinite_logits")
+                    continue
                 fl.last_token = int(next_toks[i])
                 fl.out_tokens.append(fl.last_token)
                 ledger.token(fl.length)
                 fl.length += 1
                 fl.produced += 1
-                fl.active_s += dt
-                fl.t_last = t_done
+                fl.produced_res += 1
                 if fl.produced >= fl.req.output_len:
-                    finish(fl, t_done)
+                    finish(fl, t_done, status="ok")
                 else:
                     still.append(fl)
             active[:] = still
@@ -738,121 +1033,244 @@ class ServeEngine:
                 fl.produced = 1
                 fl.active_s += dt
                 fl.t_last = t_done
-                finish(fl, t_done)
+                finish(fl, t_done, status="ok")
             active.clear()
 
+        # round 23: the drain handler + the scheduler-iteration
+        # watchdog.  The engine installs a real SIGTERM/SIGINT handler
+        # unless the caller injected one (tests poll a fake; install()
+        # is a no-op off the main thread)
+        own_handler = None
+        handler = drain_handler
+        if handler is None:
+            own_handler = preempt_mod.PreemptionHandler(
+                print_fn=self.print_fn).install()
+            handler = own_handler
+        timeout_s = watchdog_mod.resolve_timeout(
+            step_timeout_s if step_timeout_s is not None
+            else self.cfg.serve_step_timeout_s,
+            warmup_step_s=(self.compile_record["warmup_s"]
+                           / max(1, self.compile_record["buckets"])))
+        last_iter_t: list = [None]
+
+        def watchdog_forensics() -> None:
+            # round-17 forensics on the serve lane: the flight-recorder
+            # tail + the live-buffer memory dump, best-effort by
+            # contract (both swallow their own failures)
+            out_dir = getattr(writer, "out_dir", None)
+            timeline_mod.dump_timeline(out_dir, "serve_watchdog",
+                                       step=sum(steps.values()))
+            if out_dir:
+                from tpu_hc_bench.obs import memory as obs_memory
+                obs_memory.dump_forensics(out_dir, "serve_watchdog",
+                                          step=sum(steps.values()))
+
+        dog = None
+        if timeout_s:
+            dog = watchdog_mod.Watchdog(
+                timeout_s, lambda: last_iter_t[0],
+                print_fn=self.print_fn,
+                last_record_fn=lambda: getattr(writer, "last_record",
+                                               None),
+                obs_writer=writer if writer.enabled else None,
+                on_timeout=on_watchdog,
+                forensics_fn=watchdog_forensics).start()
+
         last_blocked: str | None = None
-        while len(done) < n:
-            t = now()
-            while idx < n and pending[idx].arrival_s <= t:
-                queue.append(pending[idx])
-                idx += 1
-            queue_depths.append(len(queue))
-            progressed = False
-            if batching == "continuous":
-                while queue and len(active) < self.cap and (
-                        allocator is None
-                        or allocator.free_pages >= self.table_width):
-                    admit(queue.popleft())
-                    progressed = True
-            elif not active:
-                # static: wait for a full batch (or the trace tail);
-                # the batch is additionally bounded by what the KV pool
-                # can hold — resolve() only guarantees pages for ONE
-                # request, so a tuned half-pool row would otherwise
-                # crash admission (active empty => every page is free)
-                want = min(self.cap, n - len(done))
-                if allocator is not None:
-                    want = min(want,
-                               allocator.free_pages // self.table_width)
-                if len(queue) >= want or idx == n:
-                    for _ in range(min(want, len(queue))):
-                        admit(queue.popleft())
+        try:
+            while finished < n:
+                t = now()
+                while idx < n and pending[idx].arrival_s <= t:
+                    queue.append(pending[idx])
+                    idx += 1
+                if faults is not None:
+                    sq = faults.squeezed_pages(t)
+                    if sq != squeezed_seen:
+                        self.print_fn(
+                            f"inject: pool_squeeze -> {sq} page(s) "
+                            f"withheld at t={t:.3f}s")
+                        writer.event("injected_fault",
+                                     fault="pool_squeeze", pages=sq,
+                                     t=round(t, 4))
+                        squeezed_seen = sq
+                    if faults.sigterm_due(t):
+                        self.print_fn(f"inject: sigterm at t={t:.3f}s")
+                        writer.event("injected_fault", fault="sigterm",
+                                     t=round(t, 4))
+                        faults.deliver_sigterm()
+                if handler is not None and handler.requested():
+                    drained = drain(t)
+                    break
+                queue_depths.append(len(queue))
+                progressed = False
+                if shed != "off":
+                    # expiry pass: a request past its deadline decodes
+                    # only dead tokens — shed it (queued) or retire it
+                    # (resident) with a cause instead
+                    for req in [r for r in queue
+                                if t - r.arrival_s > deadline_s]:
+                        queue.remove(req)
+                        shed_queued(req, "deadline_expired", t)
                         progressed = True
-            # admission forensics (round 22, obs.kv): when requests
-            # stay queued past the admission pass, name the BINDING
-            # resource — the scaling-policy input.  Continuous: a full
-            # batch gates before a full pool (freeing pages would not
-            # open a slot), so batch_full wins when both bind.  Static:
-            # the run-to-completion batch policy is always the gate —
-            # even a pool-capped batch admits nothing mid-flight, so
-            # scale-out (not pool growth) is the remedy.
-            blocked_cause = None
-            if queue:
-                if batching != "continuous":
-                    blocked_cause = "batch_full"
-                elif len(active) >= self.cap:
-                    blocked_cause = "batch_full"
-                elif allocator is not None and \
-                        allocator.free_pages < self.table_width:
-                    blocked_cause = "pool_starved"
-            if blocked_cause != last_blocked:
-                # edge-triggered flight-recorder instants: the moment
-                # admission blocks on (or frees from) a resource —
-                # bounded by transitions, not steps
-                if blocked_cause == "pool_starved":
-                    timeline_mod.instant("pool_starved",
-                                         queued=len(queue))
-                elif blocked_cause == "batch_full":
-                    timeline_mod.instant("batch_full", queued=len(queue))
-                last_blocked = blocked_cause
-            t_blocked = now()
-            if active:
-                decode_step() if self.decode_mode else classify_step()
-                progressed = True
-            if not progressed:
-                if idx >= n:
-                    raise RuntimeError(
-                        "serve engine stalled: queued requests, nothing "
-                        "in flight, no capacity — KV pool undersized?")
-                clock.sleep(pending[idx].arrival_s - now())
-            if blocked_cause is not None:
-                # charge the elapsed step/sleep to the blocking cause
-                # for every request that sat in queue through it (they
-                # rejoin admission only at the next loop top)
-                dt_blk = now() - t_blocked
-                if dt_blk > 0:
-                    ci = 0 if blocked_cause == "pool_starved" else 1
-                    for r in queue:
-                        wait_causes.setdefault(
-                            r.rid, [0.0, 0.0])[ci] += dt_blk
-            total_steps = sum(steps.values())
-            if total_steps - last_record_step >= _SERVE_RECORD_EVERY:
-                last_record_step = total_steps
-                if writer.enabled:
-                    writer.event(
-                        "serve", t=round(now(), 4),
-                        queue_depth=len(queue),
-                        in_flight=len(active),
-                        free_pages=(allocator.free_pages
-                                    if allocator else None),
-                        tokens=tokens_out,
-                        # running per-bucket occupancy — `obs watch`'s
-                        # live utilization column
-                        bucket_occ={k: round(u[2] / u[1], 3)
-                                    for k, u in butil.items() if u[1]},
-                        **{f"{k}_steps": v for k, v in steps.items()})
-                    if ledger is not None:
-                        # the pool ledger snapshot: counters the engine
-                        # already holds — no device round-trips
+                    for fl in [f for f in active
+                               if t - f.req.arrival_s > deadline_s]:
+                        active.remove(fl)
+                        finish(fl, t, status="shed",
+                               cause="resident_expired")
+                        progressed = True
+                if batching == "continuous":
+                    while queue and len(active) < self.cap:
+                        head = queue[0]
+                        if (shed == "deadline"
+                                and service_ewma_s is not None
+                                and (now() - head.arrival_s)
+                                + service_ewma_s > deadline_s):
+                            # predictive shed: queue wait plus the
+                            # admit-to-done EWMA already blows the
+                            # deadline — reject at admission instead
+                            # of decoding a dead answer
+                            shed_queued(queue.popleft(),
+                                        "deadline_predicted", now())
+                            progressed = True
+                            continue
+                        if allocator is None \
+                                or free_now() >= self.table_width:
+                            admit(queue.popleft())
+                            progressed = True
+                            continue
+                        if kv_preempt == "on" and preempt_one():
+                            progressed = True
+                            continue
+                        break
+                elif not active:
+                    # static: wait for a full batch (or the trace
+                    # tail); the batch is additionally bounded by what
+                    # the KV pool can hold — resolve() only guarantees
+                    # pages for ONE request, so a tuned half-pool row
+                    # would otherwise crash admission (active empty =>
+                    # every page is free)
+                    want = min(self.cap, n - finished)
+                    if allocator is not None:
+                        want = min(want,
+                                   free_now() // self.table_width)
+                    if len(queue) >= want or idx == n:
+                        for _ in range(min(want, len(queue))):
+                            admit(queue.popleft())
+                            progressed = True
+                # admission forensics (round 22, obs.kv): when requests
+                # stay queued past the admission pass, name the BINDING
+                # resource — the scaling-policy input.  Continuous: a
+                # full batch gates before a full pool (freeing pages
+                # would not open a slot), so batch_full wins when both
+                # bind.  Static: the run-to-completion batch policy is
+                # always the gate — even a pool-capped batch admits
+                # nothing mid-flight, so scale-out (not pool growth) is
+                # the remedy.
+                blocked_cause = None
+                if queue:
+                    if batching != "continuous":
+                        blocked_cause = "batch_full"
+                    elif len(active) >= self.cap:
+                        blocked_cause = "batch_full"
+                    elif allocator is not None and \
+                            free_now() < self.table_width:
+                        blocked_cause = "pool_starved"
+                if blocked_cause != last_blocked:
+                    # edge-triggered flight-recorder instants: the
+                    # moment admission blocks on (or frees from) a
+                    # resource — bounded by transitions, not steps
+                    if blocked_cause == "pool_starved":
+                        timeline_mod.instant("pool_starved",
+                                             queued=len(queue))
+                    elif blocked_cause == "batch_full":
+                        timeline_mod.instant("batch_full",
+                                             queued=len(queue))
+                    last_blocked = blocked_cause
+                t_blocked = now()
+                if active:
+                    decode_step() if self.decode_mode \
+                        else classify_step()
+                    progressed = True
+                if not progressed:
+                    if idx >= n:
+                        if shed == "off" or not queue:
+                            raise RuntimeError(
+                                "serve engine stalled: queued requests, "
+                                "nothing in flight, no capacity — KV "
+                                "pool undersized?")
+                        # shedding armed: a squeezed pool can pin the
+                        # queue with nothing resident — idle to the
+                        # next deadline; the expiry pass drains it
+                        nxt = (min(r.arrival_s for r in queue)
+                               + deadline_s)
+                        clock.sleep(max(1e-4, nxt - now() + 1e-4))
+                    else:
+                        gap = pending[idx].arrival_s - now()
+                        if timeout_s:
+                            # chunked: an idle arrival gap must never
+                            # read as a wedged scheduler
+                            gap = min(gap, timeout_s / 2)
+                        clock.sleep(gap)
+                if blocked_cause is not None:
+                    # charge the elapsed step/sleep to the blocking
+                    # cause for every request that sat in queue through
+                    # it (they rejoin admission at the next loop top)
+                    dt_blk = now() - t_blocked
+                    if dt_blk > 0:
+                        ci = 0 if blocked_cause == "pool_starved" else 1
+                        for r in queue:
+                            wait_causes.setdefault(
+                                r.rid, [0.0, 0.0])[ci] += dt_blk
+                total_steps = sum(steps.values())
+                if total_steps - last_record_step >= _SERVE_RECORD_EVERY:
+                    last_record_step = total_steps
+                    if writer.enabled:
                         writer.event(
-                            "kv_pool", t=round(now(), 4),
-                            pages_reserved=ledger.reserved_now,
-                            pages_written=ledger.written_now,
-                            free_pages=allocator.free_pages,
-                            pages_peak=allocator.pages_peak,
-                            pages_recycled=allocator.recycled,
-                            reserved_page_s=round(
-                                ledger.reserved_page_s, 6),
-                            written_page_s=round(
-                                ledger.written_page_s, 6))
-                if fleet is not None:
-                    fleet.heartbeat(
-                        step=total_steps,
-                        step_ewma_ms=1e3 * now() / max(1, total_steps),
-                        kv_peak_pages=(allocator.pages_peak
-                                       if allocator else None),
-                        phase="serve")
+                            "serve", t=round(now(), 4),
+                            queue_depth=len(queue),
+                            in_flight=len(active),
+                            free_pages=(allocator.free_pages
+                                        if allocator else None),
+                            tokens=tokens_out,
+                            # running per-bucket occupancy — `obs
+                            # watch`'s live utilization column
+                            bucket_occ={k: round(u[2] / u[1], 3)
+                                        for k, u in butil.items()
+                                        if u[1]},
+                            **{f"{k}_steps": v
+                               for k, v in steps.items()})
+                        if ledger is not None:
+                            # the pool ledger snapshot: counters the
+                            # engine already holds — no device
+                            # round-trips
+                            writer.event(
+                                "kv_pool", t=round(now(), 4),
+                                pages_reserved=ledger.reserved_now,
+                                pages_written=ledger.written_now,
+                                free_pages=allocator.free_pages,
+                                pages_peak=allocator.pages_peak,
+                                pages_recycled=allocator.recycled,
+                                reserved_page_s=round(
+                                    ledger.reserved_page_s, 6),
+                                written_page_s=round(
+                                    ledger.written_page_s, 6))
+                    if fleet is not None:
+                        fleet.heartbeat(
+                            step=total_steps,
+                            step_ewma_ms=1e3 * now()
+                            / max(1, total_steps),
+                            kv_peak_pages=(allocator.pages_peak
+                                           if allocator else None),
+                            phase="serve")
+                # a completed scheduler iteration IS progress to the
+                # watchdog — admission, shedding, and idle arrival
+                # waits all count; only a wedged step does not
+                last_iter_t[0] = time.perf_counter()
+        finally:
+            if dog is not None:
+                dog.stop()
+            if own_handler is not None:
+                own_handler.uninstall()
 
         if self.decode_mode:
             self._kv = kv
@@ -934,6 +1352,19 @@ class ServeEngine:
             **{f"{k}_steps": v for k, v in steps.items()},
             **fold,
         }
+        # round 23 degradation account: always present so `obs regress`
+        # can gate shed_frac against baselines that predate the knob
+        shed_total = sum(degrade["shed"].values())
+        summary["shed_frac"] = round(shed_total / max(1, n), 4)
+        summary["degrade"] = {
+            "shed": dict(sorted(degrade["shed"].items())),
+            "shed_frac": summary["shed_frac"],
+            "preempts": degrade["preempts"],
+            "requeues": degrade["requeues"],
+            "quarantined": degrade["quarantined"],
+        }
+        if drained is not None:
+            summary["drained"] = drained
         if self.cfg.slo_e2e_ms:
             # windowed SLO burn rate: sustained overload vs transient
             # burst, against the --slo_e2e_ms e2e target

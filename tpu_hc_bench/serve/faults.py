@@ -1,0 +1,229 @@
+"""Serve-lane fault injection + the drain/resume journal.
+
+The training lane earned deterministic fault injection in round 8
+(``resilience/inject.py``); this is the serving twin, sharing the same
+``CLASS@WHERE[:ARG]`` grammar through ``inject.split_entries`` so both
+lanes' specs parse — and fail — the same way:
+
+- ``hang@STEP:SECONDS``   — the scheduler stalls SECONDS before decode
+                            step STEP dispatches (the wedged-host
+                            signature the serve watchdog exists for).
+- ``nan_logits@RID``      — request RID's logits are poisoned
+                            non-finite (host-side, after the compiled
+                            call returns — injection must not recompile
+                            a warmed bucket) the next time RID occupies
+                            a prefill or decode row; exercises the
+                            per-request quarantine path.
+- ``sigterm@T``           — SIGTERM delivered to this process at
+                            engine-clock T seconds; exercises the
+                            drain → journal → exit-75 path.
+- ``pool_squeeze@T:PAGES`` — PAGES KV pages withheld from the allocator
+                            from engine-clock T seconds on (a sticky
+                            external memory squeeze); exercises the
+                            KV-pressure preemption/requeue path.
+
+Entries may repeat.  Parsing is loud at flag time and the error names
+BOTH lanes' vocabularies (``inject.malformed``).
+
+The journal (``write_journal``/``read_journal``) is the drain path's
+commit: every unfinished request — still queued, not yet arrived, or
+preempted mid-generation — serialized with the tmp → fsync → rename
+idiom the checkpoint sentinel uses, so a SIGTERM'd serving process
+leaves either a complete journal or none, never a torn one.
+``serve --serve_resume=<journal>`` replays every entry exactly once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+
+from tpu_hc_bench.resilience import inject as inject_mod
+
+JOURNAL_NAME = "serve_journal.json"
+
+
+@dataclasses.dataclass
+class ServeFaultPlan:
+    hang: dict[int, float]          # decode step -> seconds
+    nan_logits: frozenset[int]      # request ids to poison
+    sigterm: tuple[float, ...]      # engine-clock seconds
+    pool_squeeze: tuple[tuple[float, int], ...]  # (t_s, pages) sticky
+
+    def __bool__(self) -> bool:
+        return bool(self.hang or self.nan_logits or self.sigterm
+                    or self.pool_squeeze)
+
+    # -- engine hooks (all host-side, all cheap when inert) ------------
+
+    def hang_before_decode(self, decode_step: int) -> float:
+        """Seconds to stall before decode step ``decode_step`` (0.0
+        when none scheduled); one-shot per step number."""
+        return self.hang.pop(decode_step, 0.0)
+
+    def poison_rids(self, rids) -> list[int]:
+        """The subset of ``rids`` whose logits rows must be poisoned
+        this call (one-shot per rid: the quarantine retires it)."""
+        if not self.nan_logits:
+            return []
+        hit = [r for r in rids if r in self.nan_logits]
+        if hit:
+            self.nan_logits = self.nan_logits - frozenset(hit)
+        return hit
+
+    def sigterm_due(self, t: float) -> bool:
+        """True once per scheduled time <= ``t``; the caller delivers a
+        REAL signal so the drain path under test is the production one."""
+        due = [s for s in self.sigterm if s <= t]
+        if due:
+            self.sigterm = tuple(s for s in self.sigterm if s > t)
+        return bool(due)
+
+    def deliver_sigterm(self) -> None:
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def squeezed_pages(self, t: float) -> int:
+        """KV pages withheld from the allocator at engine-clock ``t``
+        (sticky: every trigger whose time has passed stays applied)."""
+        return sum(p for at, p in self.pool_squeeze if t >= at)
+
+
+def parse_serve_plan(spec: str | None) -> ServeFaultPlan | None:
+    """Parse the --serve_faults grammar; None/empty spec -> None."""
+    if not spec:
+        return None
+    hang: dict[int, float] = {}
+    nan_logits: set[int] = set()
+    sigterm: list[float] = []
+    squeeze: list[tuple[float, int]] = []
+    for cls, where, arg, entry in inject_mod.split_entries(
+            spec, lane="serve"):
+        try:
+            if cls == "hang":
+                if arg is None:
+                    raise ValueError
+                hang[_int_ge(where, 1)] = _pos_float(arg)
+            elif cls == "nan_logits":
+                if arg is not None:
+                    raise ValueError
+                nan_logits.add(_int_ge(where, 0))
+            elif cls == "sigterm":
+                if arg is not None:
+                    raise ValueError
+                sigterm.append(_nonneg_float(where))
+            elif cls == "pool_squeeze":
+                if arg is None:
+                    raise ValueError
+                squeeze.append((_nonneg_float(where), _int_ge(arg, 1)))
+            else:
+                raise ValueError
+        except ValueError:
+            raise ValueError(inject_mod.malformed(entry, "serve")) from None
+    return ServeFaultPlan(hang=hang, nan_logits=frozenset(nan_logits),
+                          sigterm=tuple(sorted(sigterm)),
+                          pool_squeeze=tuple(sorted(squeeze)))
+
+
+def _int_ge(s: str, floor: int) -> int:
+    v = int(s)
+    if v < floor:
+        raise ValueError
+    return v
+
+
+def _pos_float(s: str) -> float:
+    v = float(s)
+    if v <= 0:
+        raise ValueError
+    return v
+
+
+def _nonneg_float(s: str) -> float:
+    v = float(s)
+    if v < 0:
+        raise ValueError
+    return v
+
+
+# ---------------------------------------------------------------------
+# drain journal: the serving lane's "emergency checkpoint"
+
+
+def journal_entry(req, produced: int = 0, prefix=None,
+                  preempts: int = 0) -> dict:
+    """One unfinished request as a journal row.  ``prefix`` (generated
+    tokens so far) is carried for the record — the replay re-serves the
+    request from scratch, which regenerates the same tokens from the
+    same seeded model, so exactly-once means exactly one terminal
+    record per rid in the resumed run."""
+    prompt = getattr(req, "prompt", None)
+    return {
+        "rid": int(req.rid),
+        "arrival_s": float(req.arrival_s),
+        "prompt": None if prompt is None else [int(t) for t in prompt],
+        "output_len": int(req.output_len),
+        "produced": int(produced),
+        "prefix": [int(t) for t in (prefix or ())],
+        "preempts": int(preempts),
+    }
+
+
+def write_journal(path: str, entries: list[dict], *,
+                  model: str | None = None, seed=None,
+                  reason: str = "sigterm") -> str:
+    """Commit the drain journal with tmp -> fsync -> rename (the
+    checkpoint-sentinel idiom): a crash mid-write leaves no torn
+    journal for ``--serve_resume`` to half-replay."""
+    payload = {
+        "kind": "serve_journal",
+        "reason": reason,
+        "model": model,
+        "seed": seed,
+        "unfinished": len(entries),
+        "requests": sorted(entries, key=lambda e: (e["arrival_s"],
+                                                   e["rid"])),
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_journal(path: str) -> dict:
+    """Load + validate a drain journal; loud on a missing or non-journal
+    file (a resume pointed at the wrong path must not silently serve
+    zero requests)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("kind") != "serve_journal" \
+            or not isinstance(payload.get("requests"), list):
+        raise ValueError(
+            f"{path} is not a serve drain journal (expected kind="
+            f"'serve_journal' with a 'requests' list)")
+    return payload
+
+
+def journal_requests(payload: dict) -> list:
+    """Journal rows -> ``arrivals.Request`` objects for the resumed
+    run, arrival order preserved."""
+    import numpy as np
+
+    from tpu_hc_bench.serve.arrivals import Request
+
+    out = []
+    for row in payload["requests"]:
+        prompt = row.get("prompt")
+        out.append(Request(
+            rid=int(row["rid"]),
+            arrival_s=float(row["arrival_s"]),
+            prompt=(None if prompt is None
+                    else np.asarray(prompt, dtype=np.int32)),
+            output_len=int(row["output_len"])))
+    return out

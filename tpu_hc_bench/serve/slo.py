@@ -239,6 +239,25 @@ def slo_lines(fold: dict) -> list[str]:
     # tail-cause split + configured pool geometry
     lines.extend(kv_mod.kv_lines(fold))
     lines.extend(burn_lines(fold.get("slo")))
+    # round 23: the degradation account — sheds by cause, preemption/
+    # requeue traffic, quarantined poison requests.  Rendered only when
+    # the engine actually degraded; a clean run stays a clean report.
+    deg = fold.get("degrade")
+    if deg and (deg.get("shed") or deg.get("preempts")
+                or deg.get("quarantined")):
+        shed = deg.get("shed") or {}
+        parts = [f"shed {sum(shed.values())}"
+                 + (" (" + ", ".join(
+                     f"{c}x{shed[c]}" for c in kv_mod.SHED_CAUSES
+                     if c in shed) + ")" if shed else "")]
+        if deg.get("preempts"):
+            parts.append(f"preempts {deg['preempts']} "
+                         f"(requeued {deg.get('requeues', 0)})")
+        if deg.get("quarantined"):
+            parts.append(f"quarantined {deg['quarantined']}")
+        lines.append(
+            f"  degrade: {'  '.join(parts)}   "
+            f"shed_frac {deg.get('shed_frac', 0.0):.1%}")
     if fold.get("wall_s") is not None:
         lines.append(
             f"  {fold.get('tokens', 0)} tokens in "
