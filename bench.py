@@ -115,6 +115,12 @@ def _serve_main() -> int:
             # gates on (absent on pre-r22 history; the checks skip)
             "kv_pool_util": summary.get("kv_pool_util"),
             "kv_req_gap_frac": summary.get("kv_req_gap_frac"),
+            # round 24: the merged-sketch tail + fired health signals
+            # obs regress gates on (absent on pre-r24 history; skips)
+            "p99_merged_ms": summary.get("p99_merged_ms"),
+            "latency_source": summary.get("latency_source"),
+            "signals_fired": summary.get("signals_fired"),
+            "signals_fired_total": summary.get("signals_fired_total"),
             "config_source": cfg.config_source,
             "tuned_config": cfg.tuned_config,
         },

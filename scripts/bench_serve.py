@@ -44,6 +44,14 @@ vs the no-degradation control.  Headline: served-within-SLO goodput —
 the degrading arm must answer MORE of the trace correctly within
 ``--deadline_ms`` than the arm that heroically serves everything late.
 
+``--mode signals`` (round 24) is the sensing A/B: one warmed engine,
+policy knobs pinned OFF, a clean control trace vs an injected
+overload + sticky pool squeeze.  The health-signal engine must fire
+``SUSTAINED_OVERLOAD`` and ``KV_PRESSURE`` on the overload arm (the
+KV onset at/after the injection instant) and NOTHING on the control
+arm, and both arms' merged-sketch p99 must land inside the exact
+stored-sample bracket widened by the sketch's relative-error bound.
+
 Every mode folds the per-arm KV-pool ledger (``kv_pool`` /
 ``kv_pool_util`` / ``kv_req_gap_frac``) into its arms.
 
@@ -147,6 +155,11 @@ def run_ab(args) -> dict:
             "kv_pool": summary.get("kv_pool"),
             "kv_pool_util": summary.get("kv_pool_util"),
             "kv_req_gap_frac": summary.get("kv_req_gap_frac"),
+            # round 24: the merged-sketch tail + any fired health
+            # signals per arm
+            "p99_merged_ms": summary.get("p99_merged_ms"),
+            "signals_fired": summary.get("signals_fired"),
+            "signals_fired_total": summary.get("signals_fired_total"),
             "metrics_dir": mdir,
         }
 
@@ -215,6 +228,10 @@ def run_ab(args) -> dict:
             # round 22: the regress gate's allocation-honesty metric
             "kv_pool_util": ct.get("kv_pool_util"),
             "kv_req_gap_frac": ct.get("kv_req_gap_frac"),
+            # round 24: the regress gate's merged tail + fire count
+            # (headline = continuous arm, matching the other extras)
+            "p99_merged_ms": ct.get("p99_merged_ms"),
+            "signals_fired_total": ct.get("signals_fired_total"),
             # the static-vs-continuous attribution delta as `obs diff`
             # renders it (also viewable live: obs diff <root>/static
             # <root>/continuous)
@@ -603,6 +620,182 @@ def run_faults_ab(args) -> dict:
     }
 
 
+def run_signals_ab(args) -> dict:
+    """The round-24 sensing A/B: ONE warmed engine, TWO traces —
+
+    - ``control``: the default offered load (``--arrival_rate``), no
+      faults.  The health-signal engine must stay silent end to end:
+      any fire here is a false positive and fails the verdict.
+    - ``overload``: the same request shapes at ``--overload_rate``
+      (far above service capacity) plus the round-23 sticky KV-pool
+      squeeze landing at t=``FAULT_SQUEEZE_T``.  SUSTAINED_OVERLOAD
+      and KV_PRESSURE must both fire, and KV_PRESSURE's first fire
+      must land at or after the squeeze's injection instant.
+
+    Degradation policy is pinned OFF on both arms — this A/B measures
+    the autoscaler's SENSING half (does the engine see trouble, with
+    hysteresis, without crying wolf), not the actuation the policies
+    already cover in ``--mode faults``.  Both arms also check the
+    merged-sketch p99 against the exact stored-sample tail read back
+    from the full per-request stream: the sketch answer must land
+    inside the order-statistic bracket widened by the sketch's own
+    relative-error guarantee.  VirtualClock keeps the artifact a
+    deterministic property of the traces."""
+    import tempfile
+
+    from tpu_hc_bench.obs import metrics as obs_metrics
+    from tpu_hc_bench.obs import signals as signals_mod
+    from tpu_hc_bench.obs import sketch as sketch_mod
+    from tpu_hc_bench.serve import arrivals
+    from tpu_hc_bench.serve import cli as serve_cli
+    from tpu_hc_bench.serve import engine as engine_mod
+    from tpu_hc_bench.serve import faults as faults_mod
+    from tpu_hc_bench.serve import slo as slo_mod
+
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    root = args.metrics_root or tempfile.mkdtemp(prefix="bench_signals_")
+    cfg = _build_cfg(args, slo_e2e_ms=args.deadline_ms)
+    engine, requests = serve_cli.build_engine_and_requests(cfg, log)
+    vocab = engine.spec.vocab_size if engine.decode_mode else None
+    ovl_cfg = _build_cfg(args, slo_e2e_ms=args.deadline_ms,
+                         arrival_rate=args.overload_rate)
+    ovl_requests = arrivals.build_requests(ovl_cfg, vocab)
+    squeeze = max(0, engine.num_pages - 2 * engine.table_width)
+    spec = (f"pool_squeeze@{FAULT_SQUEEZE_T}:{squeeze}"
+            if squeeze else "")
+    vclock = {"prefill": 0.004, "decode": 0.003, "classify": 0.002}
+
+    arm_defs = {
+        "control": (requests, None),
+        "overload": (ovl_requests, spec or None),
+    }
+    arms: dict[str, dict] = {}
+    for arm, (trace, fault_spec) in arm_defs.items():
+        mdir = os.path.join(root, arm)
+        log(f"--- signals arm: {arm}"
+            + (f" ({fault_spec})" if fault_spec else "") + " ---")
+        writer = serve_cli.serve_writer(cfg, mdir)
+        try:
+            summary = engine.run(
+                trace, batching="continuous", writer=writer,
+                clock=engine_mod.VirtualClock(vclock),
+                faults=(faults_mod.parse_serve_plan(fault_spec)
+                        if fault_spec else None),
+                deadline_ms=args.deadline_ms, shed="off",
+                kv_preempt="off")
+        finally:
+            writer.close()
+        # exact stored-sample tail off the FULL per-request stream (the
+        # summary's own fold rides the run-lifetime sketches; the raw
+        # ring is bounded) — the sketch must land inside the exact
+        # order-statistic bracket widened by its alpha guarantee
+        e2e: list[float] = []
+        with open(os.path.join(mdir, "metrics.jsonl")) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("kind") == "request":
+                    e2e.append(float(rec["e2e_ms"]))
+        e2e.sort()
+        merged = summary.get("p99_merged_ms")
+        alpha = sketch_mod.DEFAULT_ALPHA
+        within = None
+        exact_p99 = None
+        if e2e:
+            exact_p99 = slo_mod.percentile(e2e, 99)
+            rank = 0.99 * (len(e2e) - 1)
+            lo = e2e[int(rank)]
+            hi = e2e[min(int(rank) + 1, len(e2e) - 1)]
+            within = (merged is not None
+                      and lo * (1.0 - alpha) - 1e-6 <= merged
+                      <= hi * (1.0 + alpha) + 1e-6)
+        events = signals_mod.read_signals(mdir)
+        first_fire: dict[str, float] = {}
+        for ev in events:
+            if ev.get("state") == "fire":
+                first_fire.setdefault(ev.get("signal"), ev.get("t"))
+        arms[arm] = {
+            "arrival_rate": (args.overload_rate if arm == "overload"
+                             else cfg.arrival_rate),
+            "fault_spec": fault_spec,
+            "signals_fired": summary.get("signals_fired"),
+            "signals_fired_total": summary.get("signals_fired_total"),
+            "first_fire_t": first_fire,
+            "signal_events": len(events),
+            "p99_merged_ms": summary.get("p99_merged_ms"),
+            "p99_exact_ms": (round(exact_p99, 3)
+                             if exact_p99 is not None else None),
+            "merged_vs_exact_pct": (
+                round(100.0 * (merged - exact_p99) / max(exact_p99, 1e-9),
+                      2)
+                if merged is not None and exact_p99 else None),
+            "merged_p99_within_bound": within,
+            "sketch_windows": summary.get("sketch_windows"),
+            "p99_e2e_ms": summary.get("p99_e2e_ms"),
+            "goodput": summary["goodput"],
+            "tokens_per_s": summary["tokens_per_s"],
+            "completed": summary["completed"],
+            "post_warmup_compiles": summary["post_warmup_compiles"],
+            "metrics_dir": mdir,
+        }
+
+    ctl, ovl = arms["control"], arms["overload"]
+    ovl_fired = ovl.get("signals_fired") or {}
+    kv_onset = (ovl.get("first_fire_t") or {}).get("KV_PRESSURE")
+    verdict = {
+        # the sensing acceptance: the injected overload + pool squeeze
+        # fire their signals, onset at/after injection, and the clean
+        # arm never cries wolf
+        "overload_fires_sustained_overload": (
+            ovl_fired.get("SUSTAINED_OVERLOAD", 0) >= 1),
+        "overload_fires_kv_pressure": (
+            ovl_fired.get("KV_PRESSURE", 0) >= 1),
+        "kv_onset_after_injection": (
+            kv_onset is not None and kv_onset >= FAULT_SQUEEZE_T),
+        "kv_pressure_onset_t": kv_onset,
+        "control_zero_fires": ctl.get("signals_fired_total") == 0,
+        "merged_p99_within_bound": bool(
+            ctl.get("merged_p99_within_bound")
+            and ovl.get("merged_p99_within_bound")),
+        "zero_post_warmup_compiles": (
+            ctl["post_warmup_compiles"] == 0
+            and ovl["post_warmup_compiles"] == 0),
+        "compile_record": engine.compile_record,
+    }
+    manifest = obs_metrics.manifest_subset(
+        obs_metrics.run_manifest(cfg=cfg))
+    return {
+        "metric": f"{cfg.model}_serve_signal_sensing",
+        "value": ovl.get("signals_fired_total"),
+        "unit": "signals_fired",
+        "vs_baseline": None,
+        "extra": {
+            "workload": "serve",
+            "mode": "signals",
+            "model": cfg.model,
+            "arrival": cfg.arrival,
+            "arrival_rate": cfg.arrival_rate,
+            "overload_rate": args.overload_rate,
+            "num_requests": args.num_requests,
+            "deadline_ms": args.deadline_ms,
+            "fault_spec": spec,
+            "decode_attention": cfg.decode_attention,
+            "quant": cfg.quant,
+            # regress-gated: the HEALTHY arm's merged tail and fire
+            # count — a drift in the clean config's p99 or ANY fire on
+            # it flags (the abs floor is one fire)
+            "p99_merged_ms": ctl.get("p99_merged_ms"),
+            "latency_source": "sketch",
+            "signals_fired": ctl.get("signals_fired"),
+            "signals_fired_total": ctl.get("signals_fired_total"),
+            "goodput": ctl["goodput"],
+            "tokens_per_s": ctl["tokens_per_s"],
+            "arms": arms,
+            "verdict": verdict,
+        },
+        "manifest": manifest,
+    }
+
+
 def main() -> int:
     env = os.environ.get
     ap = argparse.ArgumentParser(description=__doc__)
@@ -620,7 +813,7 @@ def main() -> int:
     ap.add_argument("--max_prompt_len", type=int, default=32)
     ap.add_argument("--max_output_len", type=int, default=16)
     ap.add_argument("--mode", choices=["batching", "decode", "kv",
-                                       "faults"],
+                                       "faults", "signals"],
                     default=env("BENCH_MODE", "batching"),
                     help="batching: continuous-vs-static on one warmed "
                          "engine; decode: gather-vs-paged-vs-int8 "
@@ -631,11 +824,20 @@ def main() -> int:
                          "the round-23 overload-survival A/B — "
                          "shedding+preemption vs no degradation under "
                          "one fault schedule, headline = served-"
-                         "within-SLO goodput")
+                         "within-SLO goodput; signals: the round-24 "
+                         "sensing A/B — injected overload + pool "
+                         "squeeze must fire SUSTAINED_OVERLOAD and "
+                         "KV_PRESSURE, the clean control arm must "
+                         "fire nothing")
     ap.add_argument("--deadline_ms", type=float,
                     default=float(env("BENCH_DEADLINE_MS", "150")),
-                    help="faults mode: the per-request e2e SLO the "
-                         "shed policy defends")
+                    help="faults/signals modes: the per-request e2e "
+                         "SLO (shed target in faults; the overload "
+                         "signal's violation threshold in signals)")
+    ap.add_argument("--overload_rate", type=float,
+                    default=float(env("BENCH_OVERLOAD_RATE", "120")),
+                    help="signals mode: the overload arm's arrival "
+                         "rate (req/s, far above service capacity)")
     ap.add_argument("--decode_attention",
                     choices=["gather", "paged"],
                     default=env("BENCH_DECODE_ATTENTION", "gather"),
@@ -660,7 +862,8 @@ def main() -> int:
     args = ap.parse_args()
 
     result = {"decode": run_decode_ab, "kv": run_kv_ab,
-              "faults": run_faults_ab}.get(args.mode, run_ab)(args)
+              "faults": run_faults_ab,
+              "signals": run_signals_ab}.get(args.mode, run_ab)(args)
     print(json.dumps(result, indent=1))
     if args.json:
         with open(args.json, "w") as f:
@@ -675,6 +878,13 @@ def main() -> int:
               and v["all_completed"])
     elif args.mode == "faults":
         ok = (v["degrade_beats_control_goodput"]
+              and v["zero_post_warmup_compiles"])
+    elif args.mode == "signals":
+        ok = (v["overload_fires_sustained_overload"]
+              and v["overload_fires_kv_pressure"]
+              and v["kv_onset_after_injection"]
+              and v["control_zero_fires"]
+              and v["merged_p99_within_bound"]
               and v["zero_post_warmup_compiles"])
     else:
         ok = (v["continuous_beats_static_p99"]

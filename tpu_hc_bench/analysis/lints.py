@@ -122,9 +122,11 @@ DEQUANT_HOT = "dequantize-in-hot-loop"
 FLEET_WAIT = "fleet-blocking-wait"
 SPAN_REGISTRY = "span-name-registry"
 RETIRE_STATUS = "retire-without-status"
+SIGNAL_REGISTRY = "signal-name-registry"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
                     INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE, SPAN_IN_JIT,
-                    DEQUANT_HOT, FLEET_WAIT, SPAN_REGISTRY, RETIRE_STATUS)
+                    DEQUANT_HOT, FLEET_WAIT, SPAN_REGISTRY, RETIRE_STATUS,
+                    SIGNAL_REGISTRY)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -948,6 +950,87 @@ class _FileLinter:
                 f"(or typo'd) name records fine and then silently "
                 f"vanishes from every timeline fold; register it in "
                 f"KNOWN_SPANS or fix the spelling")
+
+    # -- signal-name-registry ------------------------------------------
+
+    # health-signal lookups keyed by a LITERAL name, mapped to the
+    # positional index the name rides in: spec_of(name),
+    # advice_for(name), fired_count(events, name)
+    _SIGNAL_NAME_CALLEES = {"spec_of": 0, "advice_for": 0,
+                            "fired_count": 1}
+    _SIGNAL_MODULE_HINTS = ("signals",)
+
+    @functools.cached_property
+    def _signals_imported_names(self) -> set[str]:
+        """Local names bound by ``from ...obs.signals import X [as Y]``
+        — a bare ``spec_of(...)`` call through such a binding is the
+        signal engine's even when no dotted prefix betrays it."""
+        out: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.rsplit(".", 1)[-1] == "signals":
+                out.update(a.asname or a.name for a in node.names)
+        return out
+
+    @register_pass(
+        SIGNAL_REGISTRY, "warning", "file",
+        doc="a literal signal name at a signals-engine call site that "
+            "is not in obs.signals.KNOWN_SIGNALS — a typo'd name never "
+            "matches anything any engine emits",
+        example="`fired_count(events, \"KV_PRESURE\")` — always 0, "
+                "never an error")
+    def _check_signal_name_registry(self):
+        """**signal-name-registry** (warning): a literal signal name
+        passed to ``signals.spec_of``/``advice_for``/``fired_count``
+        that is not in ``obs.signals.KNOWN_SIGNALS``.
+
+        Signal names are the join key between the engine's append-only
+        ``signals.jsonl`` and every consumer (the watch column, the
+        supervisor's advice journal, the bench verdict counts) — a
+        typo'd literal compares clean against every event and the
+        consumer silently reads "never fired", the same failure class
+        the span-name registry exists for.  The registry is one tuple
+        in ``obs.signals``; adding a signal is a one-line registration
+        there.  Variable names (the engine's own ``spec_of(name)``
+        loop) are skipped — the lint is for literals, where the typo
+        class lives.
+        """
+        try:
+            from tpu_hc_bench.obs.signals import KNOWN_SIGNALS
+        except Exception:        # analysis must run without obs too
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            base = name.rsplit(".", 1)[-1]
+            if base not in self._SIGNAL_NAME_CALLEES:
+                continue
+            signals_owned = (
+                any(h in name.lower() for h in self._SIGNAL_MODULE_HINTS)
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in self._signals_imported_names))
+            if not signals_owned:
+                continue    # a generic .spec_of()/.fired_count() that
+                            # is not the signal engine's
+            idx = self._SIGNAL_NAME_CALLEES[base]
+            if len(node.args) <= idx:
+                continue
+            arg = node.args[idx]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue    # variable signal names are the caller's
+                            # contract, not a typo class
+            if arg.value in KNOWN_SIGNALS:
+                continue
+            self._emit(
+                SIGNAL_REGISTRY, node,
+                f"signal name {arg.value!r} at `{name or base}(...)` "
+                f"is not in obs.signals.KNOWN_SIGNALS — an "
+                f"unregistered (or typo'd) name never matches any "
+                f"emitted event and the consumer silently reads "
+                f"\"never fired\"; register it in KNOWN_SIGNALS or "
+                f"fix the spelling")
 
     # -- fleet-blocking-wait -------------------------------------------
 

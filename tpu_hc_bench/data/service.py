@@ -406,15 +406,16 @@ class ShmRing:
 
 
 def _hist_percentile(hist: list[int], q: float) -> int:
-    total = sum(hist)
-    if not total:
+    """Occupancy percentile off the ring's integer histogram, through
+    the round-24 mergeable sketch (small ints resolve to their own
+    buckets at the default 1% relative error, so the rounded result
+    matches the old cumulative scan for any plausible ring depth)."""
+    from tpu_hc_bench.obs import sketch as sketch_mod
+
+    sk = sketch_mod.QuantileSketch.from_counts(hist)
+    if not sk.count:
         return 0
-    acc = 0
-    for occ, n in enumerate(hist):
-        acc += n
-        if acc >= q * total:
-            return occ
-    return len(hist) - 1
+    return int(round(sk.quantile(100.0 * q)))
 
 
 def service_name(*parts) -> str:

@@ -453,6 +453,10 @@ class FleetController:
         self._events_f = open(self._events_path, "a")
         self.t0 = self.now_fn()
         self._started_unix = self.wall_fn()
+        # health-signal advisory inputs (round 24): per-job byte offset
+        # into <run_dir>/m/signals.jsonl so each tick tails only the
+        # new events
+        self._signal_offsets: dict[str, int] = {}
         self.supervisor = Supervisor(
             self.backend, os.path.join(out_dir, "jobs"), self._event)
         # arrival times: an arrive@t churn event overrides the spec
@@ -610,7 +614,56 @@ class FleetController:
                             reason=d.reason)
                 sup.preempt(d.job, now, reason="grow",
                             target_world=d.world)
+        # 6. health signals (round 24): tail each running job's
+        # signals.jsonl into the fleet journal.  ADVISORY ONLY — the
+        # journal records what the ROADMAP autoscaler would do; no
+        # scheduling lever moves off a signal yet.
+        self._scan_signals()
         self._commit_state()
+
+    def _scan_signals(self) -> None:
+        from tpu_hc_bench.obs import signals as signals_mod
+
+        for name, st in self.supervisor.jobs.items():
+            if st.status != RUNNING:
+                continue
+            path = signals_mod.signals_path(
+                os.path.join(st.run_dir, "m"))
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._signal_offsets.get(name, 0)
+            if size <= off:
+                continue
+            try:
+                with open(path) as f:
+                    f.seek(off)
+                    chunk = f.read()
+            except OSError:
+                continue
+            # only whole lines advance the offset — a mid-write tail
+            # is re-read next tick, never half-parsed
+            consumed = chunk.rfind("\n") + 1
+            self._signal_offsets[name] = off + consumed
+            for line in chunk[:consumed].splitlines():
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                self._event("signal", job=name,
+                            signal=ev.get("signal"),
+                            state=ev.get("state"),
+                            t_sig=ev.get("t"),
+                            measure=ev.get("measure"))
+                if ev.get("state") == "fire":
+                    try:
+                        advice = signals_mod.advice_for(ev["signal"])
+                    except (KeyError, ValueError):
+                        continue
+                    self._event("signal_advice", job=name,
+                                signal=ev.get("signal"), advice=advice,
+                                actuation="log-only")
 
     def _kill_all_live(self) -> None:
         for st in self.supervisor.jobs.values():

@@ -1,5 +1,5 @@
 """CLI: ``python -m tpu_hc_bench.obs`` — summarize / diff / watch /
-timeline / regress.
+timeline / signals / regress.
 
 Examples::
 
@@ -28,6 +28,10 @@ Examples::
 
     # live tail of a running (or finished) benchmark
     python -m tpu_hc_bench.obs watch /runs/r50_bs128
+
+    # health signals: recorded signals.jsonl + an offline hysteresis
+    # re-evaluation of the stream (exit 1 when anything fired)
+    python -m tpu_hc_bench.obs signals /runs/r50_serve
 
 All subcommands are pure file operations — no jax backend is touched,
 so artifacts copied off a TPU VM render fine on a laptop.
@@ -148,6 +152,18 @@ def main(argv: list[str] | None = None, out=None) -> int:
     t.add_argument("-o", "--out", default=None, metavar="TRACE_JSON",
                    help="output path (default <run_dir>/"
                         "timeline.trace.json)")
+    g = sub.add_parser("signals",
+                       help="health signals: the run's recorded "
+                            "signals.jsonl plus an offline hysteresis "
+                            "re-evaluation of the stream; exit 1 when "
+                            "anything fired")
+    g.add_argument("path")
+    g.add_argument("--window_s", type=float, default=None,
+                   help="evaluation window seconds (default: completion "
+                        "span / 8, the burn-rate convention)")
+    g.add_argument("--json", action="store_true",
+                   help="emit the raw event list as JSON instead of "
+                        "the rendered report")
     r = sub.add_parser("regress",
                        help="noise-aware regression gate: a fresh BENCH "
                             "json vs the history's median/MAD per config "
@@ -200,6 +216,19 @@ def main(argv: list[str] | None = None, out=None) -> int:
                   f"chrome://tracing or https://ui.perfetto.dev)",
                   file=out)
             return 1 if warnings else 0
+        if args.cmd == "signals":
+            from tpu_hc_bench.obs import signals as signals_mod
+
+            rep = signals_mod.evaluate_run(args.path,
+                                           window_s=args.window_s)
+            if args.json:
+                print(json.dumps({"recorded": rep["recorded"],
+                                  "evaluated": rep["evaluated"],
+                                  "fired": rep["fired"]}), file=out)
+            else:
+                print("\n".join(rep["lines"]), file=out)
+            return _report_problems(rep["problems"]) \
+                or (1 if rep["fired"] else 0)
         if args.cmd == "regress":
             from tpu_hc_bench.obs import regress as regress_mod
 

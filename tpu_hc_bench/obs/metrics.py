@@ -438,6 +438,20 @@ def summarize_run(path: str, fabric_ceiling: str | None = None,
             f"p50 {summary.get('p50_step_ms', 0.0):.2f}ms"
             f" (granularity {summary.get('p50_step_granularity', '?')} "
             f"step)  MFU {100 * summary.get('mfu', 0.0):.1f}%")
+        # round 24: the training lane's merged step-time sketch —
+        # per-rank window sketches off the stream, merged bucket-wise
+        # (a multi-stream concat folds to the true fleet-wide tail)
+        from tpu_hc_bench.obs import sketch as sketch_mod
+
+        step_sk = sketch_mod.merge_records(
+            (r.get("fields") or {}).get("step_ms")
+            for r in records if r.get("kind") == "latency_sketch")
+        if step_sk is not None and step_sk.count:
+            lines.append(
+                f"  step ms [sketch, merged] "
+                f"p50 {step_sk.quantile(50):.2f}  "
+                f"p95 {step_sk.quantile(95):.2f}  "
+                f"p99 {step_sk.quantile(99):.2f}")
         from tpu_hc_bench.obs import efficiency as eff_mod
 
         lines.extend(eff_mod.mfu_lines(summary))

@@ -71,6 +71,13 @@ CHECKS = (
     # refusing more work (capacity regression or an over-eager shed
     # heuristic); pre-r23 history lacks the field and the check skips
     (("extra", "shed_frac"), "lower", "shed frac"),
+    # round 24: the fleet-wide merged-sketch tail (the per-window
+    # sketches merged bucket-wise — exact across ranks, not an average
+    # of per-host p99s) and the health-signal count.  Pre-r24 history
+    # lacks both fields and the checks skip structurally (never
+    # KeyError), the kv_pool_util precedent.
+    (("extra", "p99_merged_ms"), "lower", "p99 merged ms"),
+    (("extra", "signals_fired_total"), "lower", "signals fired"),
 )
 
 #: identity fields folded into the fingerprint (record path order)
@@ -108,6 +115,10 @@ ABS_FLOORS = {
     "kv pool util": 0.05,
     # round 23: shed fraction is 0.0 in any well-provisioned history
     "shed frac": 0.05,
+    # round 24: fired-signal counts sit at exactly 0 in a healthy
+    # history — ONE fire is the smallest shift worth a human, so the
+    # floor sits just under it (worse must EXCEED the threshold)
+    "signals fired": 0.5,
 }
 
 

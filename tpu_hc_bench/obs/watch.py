@@ -122,6 +122,11 @@ def render(path: str, manifest: dict, records: list[dict],
     from tpu_hc_bench.serve import slo as slo_mod
 
     lines.extend(slo_mod.watch_lines(records))
+    # live health signals (round 24): currently-active signals off the
+    # append-only signals.jsonl beside the stream
+    from tpu_hc_bench.obs import signals as signals_mod
+
+    lines.extend(signals_mod.watch_lines(run_dir))
     res = [r for r in records
            if r.get("kind") in metrics_mod.RESILIENCE_KINDS]
     if res:

@@ -60,6 +60,8 @@ from tpu_hc_bench.obs import kv as kv_mod
 from tpu_hc_bench.obs import metrics as obs_metrics
 from tpu_hc_bench.obs import requests as requests_mod
 from tpu_hc_bench.obs import timeline as timeline_mod
+from tpu_hc_bench.obs import signals as signals_mod
+from tpu_hc_bench.obs import sketch as sketch_mod
 from tpu_hc_bench.resilience import preempt as preempt_mod
 from tpu_hc_bench.resilience import watchdog as watchdog_mod
 from tpu_hc_bench.serve import faults as faults_mod
@@ -69,6 +71,14 @@ from tpu_hc_bench.serve.arrivals import Request
 # serve records land every this-many engine steps — frequent enough for
 # `obs watch` to show a live queue, rare enough to stay O(run)/stream
 _SERVE_RECORD_EVERY = 16
+
+# round 24: the retained-request-record cap.  Percentiles stream
+# through the mergeable sketch (exact over the whole run, bounded
+# buckets); the raw record ring only feeds the folds that genuinely
+# need per-request rows (tail attribution, burn-rate windows, the KV
+# honesty gap), which degrade gracefully to the freshest N under a
+# week-long serve instead of growing without bound.
+_DONE_SAMPLE_CAP = 4096
 
 
 def ceil_pow2(n: int) -> int:
@@ -636,7 +646,28 @@ class ServeEngine:
             kv = self._kv
         queue: collections.deque[Request] = collections.deque()
         active: list[_InFlight] = []
-        done: list[dict] = []
+        # bounded retention (round 24): the freshest N raw records; the
+        # sketches below carry the run-lifetime percentiles
+        done: collections.deque[dict] = collections.deque(
+            maxlen=_DONE_SAMPLE_CAP)
+        completed_ok = 0
+        run_sk = {f: sketch_mod.QuantileSketch()
+                  for f in slo_mod.LATENCY_FIELDS}
+        win_sk = {f: sketch_mod.QuantileSketch()
+                  for f in slo_mod.LATENCY_FIELDS}
+        win_idx = 0
+        win_t0 = 0.0
+        last_productive = 0.0
+        win_stats: dict = {"n": 0, "viol": 0, "blocked": [0.0, 0.0]}
+        # live health signals (round 24): hysteresis-gated judgments
+        # per record window, appended to signals.jsonl beside the
+        # stream; the e2e target is the deadline (or SLO) when set —
+        # without one the overload measure is "no evidence", never 0
+        sig_engine = signals_mod.SignalEngine()
+        sig_target_ms = deadline_ms or self.cfg.slo_e2e_ms or None
+        out_dir = getattr(writer, "out_dir", None)
+        signals_file = (signals_mod.signals_path(out_dir)
+                        if writer.enabled and out_dir else None)
         idx = 0
         steps = {"prefill": 0, "decode": 0, "classify": 0}
         tokens_out = 0
@@ -655,6 +686,56 @@ class ServeEngine:
         def now() -> float:
             return clock.now() - t0
 
+        def flush_window() -> None:
+            """Close one sketch/signal window (the serve-record
+            cadence): land the window's delta sketches on the stream —
+            bucket-wise mergeable into fleet-wide percentiles — and
+            feed the live signal engine one observation."""
+            nonlocal win_idx, win_t0, last_productive
+            t = now()
+            if writer.enabled and any(sk.count for sk in win_sk.values()):
+                writer.event(
+                    "latency_sketch", t=round(t, 4), window=win_idx,
+                    fields={f: sk.to_record()
+                            for f, sk in win_sk.items() if sk.count})
+            measures: dict = {}
+            causes: dict = {}
+            if sig_target_ms and win_stats["n"]:
+                measures["SUSTAINED_OVERLOAD"] = (win_stats["viol"]
+                                                  / win_stats["n"])
+                causes["SUSTAINED_OVERLOAD"] = {
+                    "violations": win_stats["viol"],
+                    "completed": win_stats["n"],
+                    "target_ms": sig_target_ms}
+            blk = win_stats["blocked"]
+            if blk[0] + blk[1] > 1e-9:
+                measures["KV_PRESSURE"] = blk[0] / (blk[0] + blk[1])
+                causes["KV_PRESSURE"] = {
+                    "pool_starved_s": round(blk[0], 4),
+                    "batch_full_s": round(blk[1], 4),
+                    "queued": len(queue),
+                    "free_pages": (allocator.free_pages
+                                   if allocator else None)}
+            dt_win = t - win_t0
+            if dt_win > 1e-9 and (queue or active):
+                # goodput only means collapse while a backlog exists —
+                # an idle engine between arrivals is not unhealthy
+                gw = (productive_s - last_productive) / dt_win
+                measures["GOODPUT_COLLAPSE"] = gw
+                causes["GOODPUT_COLLAPSE"] = {
+                    "window_goodput": round(gw, 4),
+                    "queued": len(queue), "in_flight": len(active)}
+            events = sig_engine.observe(round(t, 4), measures, causes)
+            if events and signals_file:
+                signals_mod.append_events(signals_file, events)
+            for f in list(win_sk):
+                win_sk[f] = sketch_mod.QuantileSketch()
+            win_stats["n"] = win_stats["viol"] = 0
+            win_stats["blocked"] = [0.0, 0.0]
+            win_t0 = t
+            last_productive = productive_s
+            win_idx += 1
+
         def bucket_acct(kind: str, bucket: int, active_rows: int,
                         dt: float) -> None:
             u = butil.setdefault(f"{kind}@{bucket}", [0, 0, 0, 0.0])
@@ -665,7 +746,7 @@ class ServeEngine:
 
         def finish(fl: _InFlight, t_done: float, status: str = "ok",
                    cause: str | None = None) -> None:
-            nonlocal finished, service_ewma_s
+            nonlocal finished, service_ewma_s, completed_ok
             finished += 1
             rec = {
                 "id": fl.req.rid,
@@ -724,6 +805,18 @@ class ServeEngine:
                     service_ewma_s = (
                         svc if service_ewma_s is None
                         else 0.7 * service_ewma_s + 0.3 * svc)
+                completed_ok += 1
+                # the streaming percentile path (round 24): run- and
+                # window-scoped sketches see every completion even
+                # after the raw ring starts evicting
+                for f in slo_mod.LATENCY_FIELDS:
+                    v = rec.get(f)
+                    if isinstance(v, (int, float)):
+                        run_sk[f].add(float(v))
+                        win_sk[f].add(float(v))
+                win_stats["n"] += 1
+                if sig_target_ms and rec["e2e_ms"] > sig_target_ms:
+                    win_stats["viol"] += 1
                 done.append(rec)
                 writer.event("request", **rec)
             elif status == "shed":
@@ -1218,6 +1311,9 @@ class ServeEngine:
                     dt_blk = now() - t_blocked
                     if dt_blk > 0:
                         ci = 0 if blocked_cause == "pool_starved" else 1
+                        # the KV_PRESSURE measure: wall seconds this
+                        # window spent blocked, split by binding cause
+                        win_stats["blocked"][ci] += dt_blk
                         for r in queue:
                             wait_causes.setdefault(
                                 r.rid, [0.0, 0.0])[ci] += dt_blk
@@ -1262,6 +1358,7 @@ class ServeEngine:
                             kv_peak_pages=(allocator.pages_peak
                                            if allocator else None),
                             phase="serve")
+                    flush_window()
                 # a completed scheduler iteration IS progress to the
                 # watchdog — admission, shedding, and idle arrival
                 # waits all count; only a wedged step does not
@@ -1294,9 +1391,14 @@ class ServeEngine:
                 kv_peak_pages=(allocator.pages_peak
                                if allocator else None),
                 phase="serve")
+        # the tail window (possibly under one record cadence) still
+        # lands its sketch + one final signal observation
+        flush_window()
         entries_final = self._count_cache()
-        fold = slo_mod.fold_requests(done)
-        attribution = requests_mod.fold_attribution(done)
+        # summary percentiles come from the run-lifetime sketches —
+        # exact over every completion, not just the retained ring
+        fold = slo_mod.fold_sketches(run_sk)
+        attribution = requests_mod.fold_attribution(list(done))
         kv_fold = None
         if ledger is not None:
             kv_fold = kv_mod.fold_ledger(
@@ -1304,7 +1406,7 @@ class ServeEngine:
                 written_page_s=ledger.written_page_s,
                 pages_peak=allocator.pages_peak,
                 pages_recycled=allocator.recycled,
-                request_records=done)
+                request_records=list(done))
         summary = {
             "workload": "serve",
             "model": self.cfg.model,
@@ -1312,7 +1414,7 @@ class ServeEngine:
             "arrival": self.cfg.arrival,
             "arrival_rate": self.cfg.arrival_rate,
             "requests": n,
-            "completed": len(done),
+            "completed": completed_ok,
             "wall_s": round(wall, 4),
             "tokens": tokens_out,
             "tokens_per_s": round(tokens_out / wall, 3),
@@ -1351,6 +1453,16 @@ class ServeEngine:
                 for k, u in butil.items()},
             **{f"{k}_steps": v for k, v in steps.items()},
             **fold,
+            # round 24: the mergeable-sketch account — source label,
+            # retention cap, and the fleet-mergeable headline tail
+            # (single host: the run sketch IS the merge of its
+            # windows, so this equals p99_e2e_ms by construction)
+            "latency_source": "sketch",
+            "latency_sample_cap": _DONE_SAMPLE_CAP,
+            "sketch_windows": win_idx,
+            "p99_merged_ms": round(run_sk["e2e_ms"].quantile(99), 3),
+            "signals_fired": dict(sorted(sig_engine.fired.items())),
+            "signals_fired_total": sum(sig_engine.fired.values()),
         }
         # round 23 degradation account: always present so `obs regress`
         # can gate shed_frac against baselines that predate the knob
@@ -1369,7 +1481,7 @@ class ServeEngine:
             # windowed SLO burn rate: sustained overload vs transient
             # burst, against the --slo_e2e_ms e2e target
             summary["slo"] = slo_mod.fold_burn_rate(
-                done, self.cfg.slo_e2e_ms)
+                list(done), self.cfg.slo_e2e_ms)
         writer.event("serve_summary", **summary)
         writer.event("serve_compile", **self.compile_record,
                      entries_final=entries_final,

@@ -454,9 +454,22 @@ class _AsyncTimeline:
                 return dt, w
         return None
 
+    def step_sketch(self):
+        """The timed intervals as a weighted quantile sketch (ms per
+        step, weighted by steps spanned) — mergeable across ranks and
+        the round-24 home of the reported p50.  None before finish()."""
+        from tpu_hc_bench.obs import sketch as sketch_mod
+
+        if not self.per_step_times:
+            return None
+        sk = sketch_mod.QuantileSketch()
+        for dt, w in self.per_step_times:
+            sk.add(1e3 * dt, w)
+        return sk
+
     def p50_step_ms(self) -> float:
-        med = self._median_interval()
-        return 1e3 * med[0] if med else float("nan")
+        sk = self.step_sketch()
+        return sk.quantile(50) if sk is not None else float("nan")
 
 
 class _TraceWindow:
@@ -765,6 +778,10 @@ def _run_eval(cfg, spec, layout, mesh, state, batch_iter, global_batch,
     result.peak_hbm_bytes = mem_ledger.peak_bytes or None
     result.hbm_bytes_limit = mem_ledger.bytes_limit
     result.mem_source = mem_ledger.source
+    step_sk = timeline.step_sketch()
+    if step_sk is not None:
+        obs_writer.event("latency_sketch", window=0,
+                         fields={"step_ms": step_sk.to_record()})
     obs_writer.event("summary", eval_top_1=correct_total / seen,
                      **result.json_line())
     obs_writer.close()
@@ -2348,6 +2365,12 @@ def run_benchmark(
                       if cfg.gradient_accumulation_steps > 1 else "f32")
         summary_fields["allreduce_bytes_per_step"] = \
             obs_efficiency.grad_allreduce_bytes(state.params, accum_wire)
+    # round 24: the per-rank step-time sketch — bucket-wise mergeable
+    # across ranks, so a fleet-wide step p50/p99 is one merge away
+    step_sk = timeline.step_sketch()
+    if step_sk is not None:
+        obs_writer.event("latency_sketch", window=0,
+                         fields={"step_ms": step_sk.to_record()})
     obs_writer.event("summary", **summary_fields)
     obs_writer.close()
     fleet_writer.close()
