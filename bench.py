@@ -115,6 +115,13 @@ def _serve_main() -> int:
             # gates on (absent on pre-r22 history; the checks skip)
             "kv_pool_util": summary.get("kv_pool_util"),
             "kv_req_gap_frac": summary.get("kv_req_gap_frac"),
+            # round 25: the lazy-reservation/prefix-sharing arms (part
+            # of the regress fingerprint) and their gated metrics
+            # (absent on pre-r25 history; the checks skip)
+            "kv_reserve": summary.get("kv_reserve"),
+            "prefix_cache": summary.get("prefix_cache"),
+            "prefix_hit_frac": summary.get("prefix_hit_frac"),
+            "pages_grown_total": summary.get("pages_grown_total"),
             # round 24: the merged-sketch tail + fired health signals
             # obs regress gates on (absent on pre-r24 history; skips)
             "p99_merged_ms": summary.get("p99_merged_ms"),

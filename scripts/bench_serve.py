@@ -29,13 +29,15 @@ int8 pool's argument-byte shrink, ZERO post-warmup compiles on every
 arm, and token-for-token parity of the f32 arms (read back from the
 per-arm request records).
 
-``--mode kv`` (round 22) is the allocation-honesty A/B: the
-worst-case-reservation control (gather/off) vs int8_kv, one engine per
-arm, same trace.  The headline is the control's measured
-``kv_pool_util`` (written-page-seconds / reserved-page-seconds, from
-the ``obs.kv`` ledger) — the baseline the on-demand-paging ROADMAP
-item must move — plus the per-request reservation gap, restated in
-wasted pool bytes at each arm's page cost.
+``--mode kv`` (round 25, supersedes the round-22 honesty A/B) is the
+allocation A/B: ONE warmed engine, one fixed constrained pool, one
+trace with an imposed shared prompt prefix, THREE ``(kv_reserve,
+prefix_cache)`` arms — worst-case reservation (the round-22 control),
+lazy on-demand growth, and lazy + the COW shared-prefix cache.  The
+headline is the lazy+prefix arm's ``kv_pool_util``; the verdict
+requires strictly more admitted req/s than the control at the SAME
+pool bytes, util above the round-22 waste line, and token-for-token
+parity on every arm.
 
 ``--mode faults`` (round 23) is the overload-survival A/B: one warmed
 engine, one overload trace, one fixed fault schedule (NaN-poisoned
@@ -366,97 +368,189 @@ def run_decode_ab(args) -> dict:
     }
 
 
-KV_ARMS = (("gather", "off"), ("paged", "int8_kv"))
+#: round 25: (kv_reserve, prefix_cache) policy arms over ONE warmed
+#: engine at one FIXED constrained pool — worst-case reservation is
+#: the round-22 control whose measured waste this A/B must reclaim
+KV_ARMS = (("worst", "off"), ("lazy", "off"), ("lazy", "on"))
+
+#: virtual per-step costs (seconds) — page_copy included so the COW
+#: device copy is charged deterministically like any other program
+KV_VCLOCK = {"prefill": 0.004, "decode": 0.003, "classify": 0.002,
+             "page_copy": 0.001}
 
 
 def run_kv_ab(args) -> dict:
-    """The round-22 KV-pool honesty A/B: the worst-case-reservation
-    control (gather/off — the allocation policy EVERY arm shares) vs
-    the int8_kv arm (same reservation policy, quarter the bytes per
-    written page), one engine per arm, same seeded trace, continuous
-    batching.  The headline is the control's measured ``kv_pool_util``
-    — written-page-seconds over reserved-page-seconds, the number the
-    on-demand-paging ROADMAP item must move; the per-request honesty
-    gap (pages reserved vs pages written at retirement) says how much
-    of the pool a length-aware admission could reclaim TODAY, and the
-    int8_kv arm converts the same gap into wasted bytes at the smaller
-    page cost."""
+    """The round-25 allocation A/B: ONE warmed engine (gather/off —
+    the arms differ ONLY in allocation policy, never in kernels), ONE
+    seeded trace with an imposed shared prompt prefix, ONE fixed
+    constrained pool sized well below ``max_in_flight`` worst-case
+    tables, THREE ``(kv_reserve, prefix_cache)`` arms —
+
+    - ``worst+off``: the round-22 control — admission reserves the
+      full table width up front; the pool admits few residents and
+      ~45% of reserved page-seconds are never written.
+    - ``lazy+off``: admission reserves ``ceil(prompt/page)`` + headroom
+      and decode grows pages on demand (``--kv_preempt=on`` absorbs
+      growth failure); same pool now holds more residents.
+    - ``lazy+on``: + the COW shared-prefix cache — requests repeating
+      a page-aligned prefix map those slots to shared physical pages
+      and skip the prefill page writes for them.
+
+    The headline is the lazy+prefix arm's ``kv_pool_util``; the
+    verdict requires it to admit strictly more req/s than the control
+    AT THE SAME POOL BYTES, util above the round-22 waste line, a
+    shrunken honesty gap, and token-for-token parity of every arm
+    (sharing and growth are allocation tricks — they must never change
+    what a request decodes).  VirtualClock (with an explicit
+    ``page_copy`` cost) keeps the artifact deterministic."""
+    import dataclasses
     import tempfile
+
+    import numpy as np
 
     from tpu_hc_bench.obs import metrics as obs_metrics
     from tpu_hc_bench.serve import cli as serve_cli
+    from tpu_hc_bench.serve import engine as engine_mod
 
     log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
     root = args.metrics_root or tempfile.mkdtemp(prefix="bench_kv_")
+
+    # the FIXED constrained pool: far below max_in_flight worst-case
+    # tables (the worst arm can only hold a few residents), page 0
+    # reserved as trash — identical bytes for every arm by design
+    table_width = -(-(args.max_prompt_len + args.max_output_len)
+                    // args.kv_page_size)
+    kv_pages = 1 + max(2, args.max_in_flight // 2) * table_width
+    # offered at overload so the POOL, not the arrival process, is the
+    # bottleneck — admitted req/s then measures what each reservation
+    # policy fits into the same bytes; headroom 0 makes decode growth
+    # real (every page past the prompt's is allocated on demand)
+    cfg = _build_cfg(args, decode_attention="gather", quant="off",
+                     decode_block_pages=0, kv_pages=kv_pages,
+                     kv_growth_headroom=0,
+                     arrival_rate=max(args.arrival_rate,
+                                      args.overload_rate))
+    engine, requests = serve_cli.build_engine_and_requests(cfg, log)
+
+    # impose the shared prefix the cache exists for: every prompt's
+    # first page worth of tokens becomes one fixed seeded block (kept
+    # inside each prompt's own length — arrival times and lengths are
+    # untouched, so the trace's offered load is identical)
+    vocab = engine.spec.vocab_size
+    block = np.random.default_rng((args.seed, 25)).integers(
+        0, vocab, size=args.kv_page_size, dtype=np.int32)
+    requests = [
+        dataclasses.replace(
+            r, prompt=np.concatenate(
+                [block[:min(len(r.prompt), args.kv_page_size)],
+                 r.prompt[min(len(r.prompt), args.kv_page_size):]]))
+        if r.prompt is not None and len(r.prompt) else r
+        for r in requests]
+
     arms: dict[str, dict] = {}
-    base_cfg = None
-    for da, q in KV_ARMS:
-        arm = f"{da}+{q}"
-        cfg = _build_cfg(args, decode_attention=da, quant=q,
-                         decode_block_pages=(args.decode_block_pages
-                                             if da == "paged" else 0))
-        base_cfg = base_cfg or cfg
-        log(f"--- kv arm: {arm} ---")
-        engine, requests = serve_cli.build_engine_and_requests(cfg, log)
+    tokens: dict[str, dict] = {}
+    for kr, pc in KV_ARMS:
+        arm = f"{kr}+{pc}"
         mdir = os.path.join(root, arm.replace("+", "_"))
-        summary = serve_cli.run_serve(
-            engine, requests, serve_cli.serve_writer(cfg, mdir),
-            batching="continuous")
+        log(f"--- kv arm: kv_reserve={kr} prefix_cache={pc} ---")
+        writer = serve_cli.serve_writer(cfg, mdir)
+        try:
+            summary = engine.run(
+                requests, batching="continuous", writer=writer,
+                clock=engine_mod.VirtualClock(KV_VCLOCK),
+                kv_reserve=kr, prefix_cache=pc,
+                # lazy admission can over-admit; growth failure must
+                # preempt-and-requeue instead of stalling
+                kv_preempt=("on" if kr == "lazy" else "off"))
+        finally:
+            writer.close()
+        toks = {}
+        with open(os.path.join(mdir, "metrics.jsonl")) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("kind") == "request":
+                    toks[rec["id"]] = rec.get("generated")
+        tokens[arm] = toks
         kvf = summary.get("kv_pool") or {}
         arms[arm] = {
-            "decode_attention": da,
-            "quant": q,
+            "kv_reserve": kr,
+            "prefix_cache": pc,
             "kv_pool": summary.get("kv_pool"),
             "kv_pool_util": summary.get("kv_pool_util"),
             "kv_req_gap_frac": summary.get("kv_req_gap_frac"),
             "kv_pool_bytes": summary.get("kv_pool_bytes"),
-            "kv_scale_bytes": summary.get("kv_scale_bytes"),
             "kv_pages": engine.num_pages,
             "kv_page_size": engine.page_size,
-            # the gap in BYTES at this arm's page cost: the fraction of
-            # reserved page-seconds never written, times the pool size
+            "prefix_hit_frac": summary.get("prefix_hit_frac"),
+            "pages_grown_total": summary.get("pages_grown_total"),
+            "cow_copies": kvf.get("cow_copies"),
+            "prefix_pages_shared": kvf.get("prefix_pages_shared"),
+            # the fraction of reserved page-seconds never written,
+            # restated in pool bytes at this (shared) page cost
             "wasted_pool_bytes": (
                 round((1.0 - kvf["util"]) * summary["kv_pool_bytes"])
                 if isinstance(kvf.get("util"), (int, float))
                 and summary.get("kv_pool_bytes") else None),
+            # the admission headline at the FIXED pool: how fast the
+            # constrained pool drains the same offered trace
+            "req_per_s": round(
+                summary["completed"] / max(summary["wall_s"], 1e-9), 3),
+            "wall_s": summary["wall_s"],
             "tokens_per_s": summary["tokens_per_s"],
             "p99_e2e_ms": summary["p99_e2e_ms"],
             "goodput": summary["goodput"],
             "completed": summary["completed"],
+            "preempts": (summary.get("degrade") or {}).get("preempts"),
             "post_warmup_compiles": summary["post_warmup_compiles"],
             "metrics_dir": mdir,
         }
 
-    ctl = arms[f"{KV_ARMS[0][0]}+{KV_ARMS[0][1]}"]
-    kv8 = arms[f"{KV_ARMS[1][0]}+{KV_ARMS[1][1]}"]
-    util = ctl.get("kv_pool_util")
-    gap = ctl.get("kv_req_gap_frac")
+    ctl = arms["worst+off"]
+    lzy = arms["lazy+off"]
+    shr = arms["lazy+on"]
+    util = shr.get("kv_pool_util")
+    gap = shr.get("kv_req_gap_frac")
     verdict = {
-        # the measurement exists and is a real gap, not a rounding
-        # artifact: worst-case reservation writes strictly less than it
-        # reserves whenever any output runs short of max
-        "gap_measured": (isinstance(util, (int, float)) and util < 1.0
-                         and isinstance(gap, (int, float)) and gap > 0.0),
-        "control_kv_pool_util": util,
-        "control_req_gap_frac": gap,
-        # both arms run the same reservation policy: the honesty gap is
-        # a property of admission, not of the page encoding
-        "same_gap_across_arms": (
-            ctl.get("kv_req_gap_frac") == kv8.get("kv_req_gap_frac")),
-        "int8_wasted_pool_bytes": kv8.get("wasted_pool_bytes"),
-        "control_wasted_pool_bytes": ctl.get("wasted_pool_bytes"),
+        # round-22 carryover: the control still measures a real gap
+        "gap_measured": (
+            isinstance(ctl.get("kv_pool_util"), (int, float))
+            and ctl["kv_pool_util"] < 1.0
+            and isinstance(ctl.get("kv_req_gap_frac"), (int, float))
+            and ctl["kv_req_gap_frac"] > 0.0),
+        "control_kv_pool_util": ctl.get("kv_pool_util"),
+        "control_req_gap_frac": ctl.get("kv_req_gap_frac"),
+        # the round-25 acceptance: same pool bytes, more admitted req/s
+        "lazy_prefix_beats_control_req_per_s": (
+            shr["req_per_s"] > ctl["req_per_s"]),
+        "same_pool_bytes_across_arms": (
+            len({a["kv_pool_bytes"] for a in arms.values()}) == 1),
+        "lazy_prefix_kv_pool_util": util,
+        "lazy_prefix_req_gap_frac": gap,
+        "util_above_waste_line": (
+            isinstance(util, (int, float)) and util > 0.55),
+        "gap_below_r22_waste": (
+            isinstance(gap, (int, float)) and gap < 0.382),
+        "prefix_hit_frac": shr.get("prefix_hit_frac"),
+        "pages_grown_total": lzy.get("pages_grown_total"),
+        "cow_copies": shr.get("cow_copies"),
+        # allocation tricks never change tokens: both lazy arms decode
+        # the exact streams of the worst-case control
+        "lazy_token_parity": tokens["lazy+off"] == tokens["worst+off"],
+        "prefix_token_parity": tokens["lazy+on"] == tokens["worst+off"],
         "zero_post_warmup_compiles": all(
             a["post_warmup_compiles"] == 0 for a in arms.values()),
         "all_completed": all(a["completed"] == args.num_requests
                              for a in arms.values()),
     }
     manifest = obs_metrics.manifest_subset(
-        obs_metrics.run_manifest(cfg=base_cfg))
+        obs_metrics.run_manifest(cfg=cfg))
     return {
         "metric": f"{args.model}_kv_pool_util",
         "value": util,
         "unit": "written_page_s/reserved_page_s",
-        "vs_baseline": None,
+        "vs_baseline": (
+            round(util / max(ctl.get("kv_pool_util") or 1e-9, 1e-9), 3)
+            if isinstance(util, (int, float)) else None),
         "extra": {
             "workload": "serve",
             "mode": "kv",
@@ -466,12 +560,18 @@ def run_kv_ab(args) -> dict:
             "max_prompt_len": args.max_prompt_len,
             "max_output_len": args.max_output_len,
             "kv_page_size": args.kv_page_size,
-            "decode_attention": KV_ARMS[0][0],
-            "quant": KV_ARMS[0][1],
+            "kv_pages": kv_pages,
+            "decode_attention": "gather",
+            "quant": "off",
+            # headline arm = lazy+prefix (what the regress gate tracks)
+            "kv_reserve": "lazy",
+            "prefix_cache": "on",
             "kv_pool_util": util,
             "kv_req_gap_frac": gap,
-            "goodput": ctl["goodput"],
-            "tokens_per_s": ctl["tokens_per_s"],
+            "prefix_hit_frac": shr.get("prefix_hit_frac"),
+            "pages_grown_total": shr.get("pages_grown_total"),
+            "goodput": shr["goodput"],
+            "tokens_per_s": shr["tokens_per_s"],
             "arms": arms,
             "verdict": verdict,
         },
@@ -818,9 +918,11 @@ def main() -> int:
                     help="batching: continuous-vs-static on one warmed "
                          "engine; decode: gather-vs-paged-vs-int8 "
                          "kernel arms, one engine each; kv: the "
-                         "round-22 allocation-honesty A/B — "
-                         "worst-case-reservation control vs int8_kv, "
-                         "headline = measured kv_pool_util; faults: "
+                         "round-25 allocation A/B — worst-case "
+                         "reservation vs lazy growth vs lazy+COW "
+                         "prefix cache on one engine at one fixed "
+                         "pool, headline = lazy+prefix kv_pool_util; "
+                         "faults: "
                          "the round-23 overload-survival A/B — "
                          "shedding+preemption vs no degradation under "
                          "one fault schedule, headline = served-"
@@ -874,7 +976,13 @@ def main() -> int:
         ok = (v["paged_temp_lt_gather"] and v["paged_token_parity"]
               and v["zero_post_warmup_compiles"] and v["all_completed"])
     elif args.mode == "kv":
-        ok = (v["gap_measured"] and v["zero_post_warmup_compiles"]
+        ok = (v["gap_measured"]
+              and v["lazy_prefix_beats_control_req_per_s"]
+              and v["same_pool_bytes_across_arms"]
+              and v["util_above_waste_line"]
+              and v["gap_below_r22_waste"]
+              and v["lazy_token_parity"] and v["prefix_token_parity"]
+              and v["zero_post_warmup_compiles"]
               and v["all_completed"])
     elif args.mode == "faults":
         ok = (v["degrade_beats_control_goodput"]

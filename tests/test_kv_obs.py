@@ -419,3 +419,114 @@ def test_known_spans_cover_kv_instants():
     # the engine's edge-triggered cause instants are literal names the
     # span-name-registry lint checks against KNOWN_SPANS
     assert {"pool_starved", "batch_full"} <= timeline_mod.KNOWN_SPANS
+
+
+# --- round 25: growth/sharing counters, back-compat + regress ---------
+
+
+def test_pre_r25_stream_folds_growth_absent_not_error(moe_ab):
+    """Records predating round 25 carry neither the growth counters on
+    kv_pool nor the pages_grown/prefix_pages_shared footprint fields:
+    the fold omits the section fields entirely (no fake zeros) and the
+    footprint normalizer reads 0, labeled — the same seam as r20/r22."""
+    r25_keys = ("pages_grown", "prefix_pages_shared", "pages_cow",
+                "prefix_hits", "prefix_lookups", "prefix_hit_frac")
+    old = [{k: v for k, v in r.items() if k not in r25_keys}
+           for r in _records_of(moe_ab["continuous"]["mdir"])]
+    fold = kv.fold_kv(old)
+    assert fold is not None and fold["util"] is not None
+    assert "pages_grown" not in fold
+    assert "prefix_hit_frac" not in fold and "prefix_lookups" not in fold
+    for r in old:
+        if r.get("kind") == "request":
+            fp = kv.footprint_of(r)
+            assert fp["pages_grown"] == 0
+            assert fp["prefix_pages_shared"] == 0
+    flat = kv.flatten_kv(fold)
+    assert "prefix_hit_frac" not in flat
+    assert "pages_grown_total" not in flat
+    # rendering an old fold raises nothing and adds no prefix line
+    assert all("prefix cache" not in ln for ln in kv.kv_lines(
+        {"kv_pool": fold}))
+
+
+def test_r25_stream_carries_growth_counters(moe_ab):
+    """The post-r25 engine always stamps the counters (0 on a cache-off
+    run) so the offline fold and the engine's own summary agree."""
+    recs = _records_of(moe_ab["continuous"]["mdir"])
+    pools = [r for r in recs if r.get("kind") == "kv_pool"]
+    assert all("pages_grown" in p and "prefix_pages_shared" in p
+               for p in pools)
+    fold = kv.fold_kv(recs)
+    assert fold["pages_grown"] == 0 and fold["cow_copies"] == 0
+    # cache off: no lookups -> structurally absent hit rate, never 0.0
+    assert fold["prefix_lookups"] == 0
+    assert fold["prefix_hit_frac"] is None
+    reqs = [r for r in recs if r.get("kind") == "request"]
+    assert all(kv.footprint_of(r)["pages_grown"] == 0 for r in reqs)
+
+
+def test_regress_gates_on_prefix_hit_drop():
+    """A prefix-cache hit-rate drop flags direction-aware (down =
+    regression, the pool re-pays prefill writes it had been sharing);
+    cache-off and pre-r25 records lack the field and skip structurally."""
+    base = {"metric": "moe_tiny_serve_tokens_per_s", "value": 100.0,
+            "unit": "tokens/sec",
+            "extra": {"batching": "continuous", "arrival_rate": 16.0,
+                      "p99_ms": 100.0, "goodput": 0.5,
+                      "tokens_per_s": 100.0,
+                      "kv_reserve": "lazy", "prefix_cache": "on",
+                      "prefix_hit_frac": 0.40}}
+    hist = [json.loads(json.dumps(base)) for _ in range(4)]
+    fresh = json.loads(json.dumps(base))
+    fresh["extra"]["prefix_hit_frac"] = 0.05     # sharing collapsed
+    verdict = regress.regress_check(fresh, hist)
+    assert any(r["metric"] == "prefix hit frac"
+               for r in verdict["regressions"])
+    # a RISE in hit rate is an improvement, never a regression
+    better = json.loads(json.dumps(base))
+    better["extra"]["prefix_hit_frac"] = 0.90
+    assert not any(r["metric"] == "prefix hit frac" for r in
+                   regress.regress_check(better, hist)["regressions"])
+    # sub-floor jitter never flags (5pp absolute floor)
+    jitter = json.loads(json.dumps(base))
+    jitter["extra"]["prefix_hit_frac"] = 0.37
+    assert not any(r["metric"] == "prefix hit frac" for r in
+                   regress.regress_check(jitter, hist)["regressions"])
+    # history with the cache on but no hit field (truncated runs):
+    # the check skips, the rest of the gate still runs
+    old_hist = []
+    for h in hist:
+        h = json.loads(json.dumps(h))
+        del h["extra"]["prefix_hit_frac"]
+        old_hist.append(h)
+    verdict = regress.regress_check(fresh, old_hist)
+    assert verdict["history_n"] == 4
+    assert not any(r["metric"] == "prefix hit frac"
+                   for r in verdict["regressions"])
+
+
+def test_regress_fingerprints_reservation_arms():
+    """A lazy+prefix run must never gate against worst-case history —
+    the arms are config identity; pre-r25 records (no fields at all)
+    normalize to worst/off and keep comparing against fresh
+    default-arm runs instead of being orphaned."""
+    base = {"metric": "moe_tiny_serve_tokens_per_s", "value": 100.0,
+            "unit": "tokens/sec",
+            "extra": {"batching": "continuous", "arrival_rate": 16.0,
+                      "tokens_per_s": 100.0}}
+    pre_r25 = [json.loads(json.dumps(base)) for _ in range(4)]
+    shared = json.loads(json.dumps(base))
+    shared["extra"].update(kv_reserve="lazy", prefix_cache="on")
+    shared["extra"]["tokens_per_s"] = 10.0       # huge drop, wrong arm
+    verdict = regress.regress_check(shared, pre_r25)
+    assert verdict["history_n"] == 0             # never cross-gated
+    # a fresh default-arm run (explicit worst/off) still compares
+    # against the same pre-r25 history via the fingerprint defaults
+    default_arm = json.loads(json.dumps(base))
+    default_arm["extra"].update(kv_reserve="worst", prefix_cache="off")
+    default_arm["extra"]["tokens_per_s"] = 10.0
+    verdict = regress.regress_check(default_arm, pre_r25)
+    assert verdict["history_n"] == 4
+    assert any(r["metric"] == "tokens/s"
+               for r in verdict["regressions"])

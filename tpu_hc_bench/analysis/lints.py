@@ -123,10 +123,11 @@ FLEET_WAIT = "fleet-blocking-wait"
 SPAN_REGISTRY = "span-name-registry"
 RETIRE_STATUS = "retire-without-status"
 SIGNAL_REGISTRY = "signal-name-registry"
+PAGE_REFCOUNT = "page-refcount-discipline"
 ALL_SOURCE_LINTS = (HOST_SYNC, RECOMPILE, DONATION, CKPT_TOPOLOGY,
                     INPUT_POOL, HOT_MEMORY, SERVE_RECOMPILE, SPAN_IN_JIT,
                     DEQUANT_HOT, FLEET_WAIT, SPAN_REGISTRY, RETIRE_STATUS,
-                    SIGNAL_REGISTRY)
+                    SIGNAL_REGISTRY, PAGE_REFCOUNT)
 
 # callables whose function-valued arguments are traced (jit contexts)
 _TRACING_CALLEES = {
@@ -1129,6 +1130,95 @@ class _FileLinter:
                 "status — stamp `status=` (and `cause=` for degraded "
                 "exits) so the ledger, `obs summarize`, and the faults "
                 "A/B agree on every request's disposition")
+
+    # -- page-refcount-discipline --------------------------------------
+
+    # mutating methods on a free-list container
+    _FREELIST_MUTATORS = {"append", "extend", "insert", "pop", "remove",
+                          "clear"}
+
+    def _inside_page_allocator(self, node: ast.AST) -> bool:
+        """True when ``node`` sits lexically inside ``class
+        PageAllocator`` — the one namespace sanctioned to touch the
+        free list and write page tables."""
+        cur: ast.AST | None = node
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef) and \
+                    cur.name == "PageAllocator":
+                return True
+            cur = self._parents.get(cur)
+        return False
+
+    @register_pass(
+        PAGE_REFCOUNT, "error", "file",
+        doc="a page-table store or free-list mutation outside "
+            "PageAllocator — bypasses the refcount that keeps "
+            "shared/COW pages alive",
+        example="`fl.table[slot] = page` instead of "
+                "`allocator.bind(fl.table, slot, page)`")
+    def _check_page_refcount(self):
+        """**page-refcount-discipline** (error, serve package only):
+        a page-table slot store or a free-list mutation reached from
+        outside ``class PageAllocator``.
+
+        Round 25 makes KV pages reference-counted: the prefix cache
+        and every in-flight request may hold refs on the same physical
+        page, and a page returns to the free list only when its
+        refcount hits zero inside ``PageAllocator.free``.  A direct
+        ``table[slot] = page`` store skips the liveness assert in
+        ``PageAllocator.bind`` (binding a freed page silently corrupts
+        another request's KV), and an out-of-band
+        ``free_list.append(...)`` double-frees a page someone still
+        reads.  Flagged: (a) mutating-method calls
+        (append/extend/insert/pop/remove/clear) on a name ending in
+        ``_free`` or ``free_list``; (b) subscript assignment into a
+        bare ``table`` variable or ``.table`` attribute.  Plural
+        spellings (``tables[i] = ...``) and anything lexically inside
+        ``PageAllocator`` are exempt.
+        """
+        if not self._in_serve_package():
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in self._FREELIST_MUTATORS:
+                owner = _dotted(node.func.value)
+                base = owner.rsplit(".", 1)[-1]
+                if not (base.endswith("_free") or base == "free_list"):
+                    continue
+                if self._inside_page_allocator(node):
+                    continue
+                self._emit(
+                    PAGE_REFCOUNT, node,
+                    f"`{owner}.{node.func.attr}(...)` mutates a KV "
+                    "free list outside PageAllocator — pages return "
+                    "to the pool only via `PageAllocator.free`, which "
+                    "decrefs and recycles at refcount zero; an "
+                    "out-of-band free double-frees a page a shared "
+                    "prefix or another request still reads")
+                continue
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if not isinstance(tgt, ast.Subscript):
+                    continue
+                val = tgt.value
+                name = val.attr if isinstance(val, ast.Attribute) \
+                    else val.id if isinstance(val, ast.Name) else ""
+                if name != "table":
+                    continue
+                if self._inside_page_allocator(node):
+                    continue
+                self._emit(
+                    PAGE_REFCOUNT, node,
+                    f"`{_dotted(val) or name}[...] = ...` stores a "
+                    "page id without the refcount-liveness check — "
+                    "route table writes through "
+                    "`PageAllocator.bind(table, slot, page)`, which "
+                    "asserts the page is live before it becomes "
+                    "readable by the decode kernel")
 
     # -- serve-bucket-recompile ----------------------------------------
 

@@ -99,6 +99,8 @@ SERVE_ONLY_FLAGS = (
     # stay train-only; neither lane ever silently eats the other's)
     "deadline_ms", "shed", "kv_preempt", "serve_faults",
     "serve_journal", "serve_resume", "serve_step_timeout_s",
+    # round 25: lazy KV reservation + shared-prefix cache
+    "kv_reserve", "prefix_cache", "kv_growth_headroom",
 )
 
 
@@ -636,6 +638,41 @@ class BenchmarkConfig:
                                               # watchdog: no iteration
                                               # within this -> timeline/
                                               # memory dumps + exit 70
+    kv_reserve: str = "worst"                 # KV reservation policy
+                                              # (round 25): worst =
+                                              # reserve every request's
+                                              # worst-case page count at
+                                              # admission (the r22-
+                                              # measured 45%-waste
+                                              # control) | lazy =
+                                              # reserve ceil(prompt/
+                                              # page) + kv_growth_
+                                              # headroom and grow one
+                                              # page on each crossed
+                                              # boundary; a failed
+                                              # growth falls back to
+                                              # prefix-cache eviction,
+                                              # then --kv_preempt
+    prefix_cache: str = "off"                 # shared-prefix KV cache
+                                              # (round 25): on = a
+                                              # prefix trie keyed on
+                                              # page-aligned prompt
+                                              # chunks maps common
+                                              # prefixes to shared,
+                                              # refcounted physical
+                                              # pages; cache-hit admits
+                                              # skip the page WRITES
+                                              # for shared slots and
+                                              # the first append into a
+                                              # shared page copies it
+                                              # (COW).  Requires
+                                              # --kv_reserve=lazy
+    kv_growth_headroom: int = 1               # decode pages reserved
+                                              # beyond the prompt at
+                                              # lazy admission — the
+                                              # slack that keeps the
+                                              # first decode steps from
+                                              # immediately growing
 
     # Populated by resolve():
     translations: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -759,6 +796,23 @@ class BenchmarkConfig:
         if self.kv_preempt not in ("off", "on"):
             raise ValueError(
                 f"--kv_preempt must be off|on: {self.kv_preempt!r}")
+        # round 25: lazy reservation + shared-prefix cache
+        if self.kv_reserve not in ("worst", "lazy"):
+            raise ValueError(
+                f"--kv_reserve must be worst|lazy: {self.kv_reserve!r}")
+        if self.prefix_cache not in ("off", "on"):
+            raise ValueError(
+                f"--prefix_cache must be off|on: {self.prefix_cache!r}")
+        if self.prefix_cache == "on" and self.kv_reserve != "lazy":
+            raise ValueError(
+                "--prefix_cache=on shares pages a worst-case "
+                "reservation would immediately duplicate; set "
+                "--kv_reserve=lazy (sharing only saves pages when "
+                "admission stops reserving the worst case)")
+        if self.kv_growth_headroom < 0:
+            raise ValueError(
+                f"--kv_growth_headroom must be >= 0 pages: "
+                f"{self.kv_growth_headroom}")
         if self.serve_faults:
             from tpu_hc_bench.serve.faults import parse_serve_plan
 
@@ -1202,6 +1256,11 @@ class BenchmarkConfig:
                 + (f" decode_block_pages={self.decode_block_pages}"
                    if self.decode_block_pages else ""),
             ]
+            if self.kv_reserve != "worst" or self.prefix_cache != "off":
+                lines.append(
+                    f"kv_reserve={self.kv_reserve} "
+                    f"prefix_cache={self.prefix_cache} "
+                    f"growth_headroom={self.kv_growth_headroom}")
             if (self.shed != "off" or self.kv_preempt != "off"
                     or self.serve_faults or self.serve_resume
                     or self.serve_step_timeout_s):
@@ -1407,6 +1466,13 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="JOURNAL")
     p.add_argument("--serve_step_timeout_s", type=str, default=None,
                    metavar="SECONDS")
+    # --- round 25: lazy KV reservation + shared-prefix cache ---
+    p.add_argument("--kv_reserve", type=str, default=d.kv_reserve,
+                   choices=["worst", "lazy"])
+    p.add_argument("--prefix_cache", type=str, default=d.prefix_cache,
+                   choices=["off", "on"])
+    p.add_argument("--kv_growth_headroom", type=int,
+                   default=d.kv_growth_headroom)
     return p
 
 

@@ -45,6 +45,13 @@ KV_POOL_KIND = "kv_pool"
 #: they diverge once mid-flight page release / on-demand paging lands.
 FOOTPRINT_KEYS = ("pages_reserved", "pages_peak_used", "pages_final")
 
+#: round 25 footprint fields: pages grown on demand after admission
+#: and page slots admitted pointing at shared prefix-cache pages.
+#: Absent on pre-r25 records — normalized to 0 (the r20/r22 seam), so
+#: old streams flow through fold_attribution / obs diff / obs regress
+#: without KeyError.
+GROWTH_KEYS = ("pages_grown", "prefix_pages_shared")
+
 #: queue-wait causes, in render order (and the engine's charge order)
 WAIT_CAUSES = ("pool_starved", "batch_full")
 
@@ -70,8 +77,14 @@ def footprint_of(record: dict) -> dict | None:
     final = record.get("pages_final")
     if not all(isinstance(v, (int, float)) for v in (res, peak, final)):
         return None
-    return {"pages_reserved": int(res), "pages_peak_used": int(peak),
-            "pages_final": int(final)}
+    out = {"pages_reserved": int(res), "pages_peak_used": int(peak),
+           "pages_final": int(final)}
+    for key in GROWTH_KEYS:
+        # round 25 fields: a pre-r25 record simply never grew or
+        # shared a page — 0, labeled by key, never a KeyError
+        v = record.get(key)
+        out[key] = int(v) if isinstance(v, (int, float)) else 0
+    return out
 
 
 def has_footprints(request_records: list[dict]) -> bool:
@@ -134,12 +147,19 @@ def fold_wait_causes(request_records: list[dict],
 def fold_ledger(*, reserved_page_s: float, written_page_s: float,
                 pages_peak: int | None = None,
                 pages_recycled: int | None = None,
+                pages_grown: int | None = None,
+                cow_copies: int | None = None,
+                prefix_hits: int | None = None,
+                prefix_lookups: int | None = None,
+                prefix_pages_shared: int | None = None,
                 request_records: list[dict] = ()) -> dict:
     """The ONE ledger fold (engine-side and offline callers share it,
     so the engine's final print and ``obs summarize`` agree by
     construction): page-seconds integrals -> utilization, request
     footprints -> the mean honesty gap, cause fields -> the tail
-    cause split."""
+    cause split, and (round 25) the growth/sharing counters ->
+    ``prefix_hit_frac``.  The r25 kwargs default to ``None`` so a
+    pre-r25 caller folds exactly as before."""
     rs = float(reserved_page_s or 0.0)
     ws = float(written_page_s or 0.0)
     out: dict = {
@@ -150,6 +170,20 @@ def fold_ledger(*, reserved_page_s: float, written_page_s: float,
         "pages_recycled": (int(pages_recycled)
                            if pages_recycled is not None else None),
     }
+    if pages_grown is not None:
+        out["pages_grown"] = int(pages_grown)
+    if cow_copies is not None:
+        out["cow_copies"] = int(cow_copies)
+    if prefix_pages_shared is not None:
+        out["prefix_pages_shared"] = int(prefix_pages_shared)
+    if prefix_lookups is not None:
+        out["prefix_lookups"] = int(prefix_lookups)
+        out["prefix_hits"] = int(prefix_hits or 0)
+        # None (not 0.0) when the cache never looked anything up —
+        # regress must skip structurally, not gate on a fake zero
+        out["prefix_hit_frac"] = (
+            round(int(prefix_hits or 0) / int(prefix_lookups), 4)
+            if int(prefix_lookups) > 0 else None)
     fps = [f for f in (footprint_of(r) for r in request_records) if f]
     if fps:
         res = sum(f["pages_reserved"] for f in fps)
@@ -181,15 +215,22 @@ def fold_kv(records: list[dict]) -> dict | None:
     def _num(v):
         return float(v) if isinstance(v, (int, float)) else 0.0
 
+    def _int(key):
+        v = last.get(key)
+        return int(v) if isinstance(v, (int, float)) else None
+
     return fold_ledger(
         reserved_page_s=_num(last.get("reserved_page_s")),
         written_page_s=_num(last.get("written_page_s")),
-        pages_peak=(int(last["pages_peak"])
-                    if isinstance(last.get("pages_peak"), (int, float))
-                    else None),
-        pages_recycled=(int(last["pages_recycled"])
-                        if isinstance(last.get("pages_recycled"),
-                                      (int, float)) else None),
+        pages_peak=_int("pages_peak"),
+        pages_recycled=_int("pages_recycled"),
+        # round 25 counters: absent on pre-r25 kv_pool records, and
+        # fold_ledger omits the fields entirely then (no fake zeros)
+        pages_grown=_int("pages_grown"),
+        cow_copies=_int("pages_cow"),
+        prefix_hits=_int("prefix_hits"),
+        prefix_lookups=_int("prefix_lookups"),
+        prefix_pages_shared=_int("prefix_pages_shared"),
         request_records=reqs)
 
 
@@ -206,6 +247,15 @@ def flatten_kv(kv_fold: dict | None) -> dict:
     g = kv_fold.get("req_gap_frac")
     if isinstance(g, (int, float)):
         out["kv_req_gap_frac"] = g
+    # round 25: the sharing hit rate (gated: a drop = regression) and
+    # the growth count — absent when the run predates round 25 or the
+    # cache never looked anything up (regress skips structurally)
+    h = kv_fold.get("prefix_hit_frac")
+    if isinstance(h, (int, float)):
+        out["prefix_hit_frac"] = h
+    pg = kv_fold.get("pages_grown")
+    if isinstance(pg, (int, float)):
+        out["pages_grown_total"] = pg
     return out
 
 
@@ -234,7 +284,23 @@ def kv_lines(fold: dict) -> list[str]:
                 head += " pages"
             if kvf.get("pages_recycled") is not None:
                 head += f"  recycled {kvf['pages_recycled']}"
+            if kvf.get("pages_grown") is not None:
+                # round 25 on-demand growth (recycled and COW copies
+                # are tracked apart — a copy is not a recycle)
+                head += f"  grown {kvf['pages_grown']}"
             lines.append(head)
+        if kvf.get("prefix_lookups") is not None:
+            hf = kvf.get("prefix_hit_frac")
+            cow = kvf.get("cow_copies") or 0
+            lines.append(
+                "  prefix cache: "
+                + (f"{hf:.1%} hit rate"
+                   if isinstance(hf, (int, float)) else "no lookups")
+                + f" ({kvf.get('prefix_hits', 0)}/"
+                  f"{kvf.get('prefix_lookups', 0)}), "
+                  f"{kvf.get('prefix_pages_shared', 0)} shared "
+                  f"page-slot(s), {cow} COW cop"
+                  f"{'y' if cow == 1 else 'ies'}")
         if isinstance(kvf.get("req_gap_frac"), (int, float)):
             lines.append(
                 f"  reservation honesty: "
@@ -272,7 +338,9 @@ def kv_diff_lines(fold_a: dict | None, fold_b: dict | None) -> list[str]:
     if not ka and not kb:
         return []
     lines = ["  kv pool (written/reserved page-seconds):"]
-    rows = [("kv_pool_util", "util"), ("kv req gap", "req_gap_frac")]
+    rows = [("kv_pool_util", "util"), ("kv req gap", "req_gap_frac"),
+            # round 25: sides without a cache (pre-r25 or off) read 0
+            ("prefix hits", "prefix_hit_frac")]
     for label, key in rows:
         va = (ka or {}).get(key)
         vb = (kb or {}).get(key)

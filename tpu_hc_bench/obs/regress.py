@@ -78,6 +78,13 @@ CHECKS = (
     # KeyError), the kv_pool_util precedent.
     (("extra", "p99_merged_ms"), "lower", "p99 merged ms"),
     (("extra", "signals_fired_total"), "lower", "signals fired"),
+    # round 25 (obs.kv): the shared-prefix hit rate.  A DROP means the
+    # cache stopped matching traffic it used to match (an eviction
+    # policy regression, a trie keying bug, or admission bypassing the
+    # cache) and the pool re-pays prefill writes it had been sharing;
+    # pre-r25 history (and cache-off runs) lack the field and the
+    # check skips structurally (never KeyError)
+    (("extra", "prefix_hit_frac"), "higher", "prefix hit frac"),
 )
 
 #: identity fields folded into the fingerprint (record path order)
@@ -89,6 +96,9 @@ FINGERPRINT_KEYS = (
     # round 18: the kernel/quant arms are config identity, not noise —
     # a gather-vs-paged pair must never share a history fingerprint
     ("extra", "decode_attention"), ("extra", "quant"),
+    # round 25: the reservation/sharing arms likewise — a lazy+prefix
+    # run must never gate against worst-case-reservation history
+    ("extra", "kv_reserve"), ("extra", "prefix_cache"),
     ("manifest", "device_kind"), ("manifest", "process_count"),
 )
 
@@ -98,6 +108,10 @@ FINGERPRINT_KEYS = (
 _FINGERPRINT_DEFAULTS = {
     ("extra", "decode_attention"): "gather",
     ("extra", "quant"): "off",
+    # pre-round-25 serve history effectively ran worst-case
+    # reservation with no prefix cache
+    ("extra", "kv_reserve"): "worst",
+    ("extra", "prefix_cache"): "off",
 }
 
 DEFAULT_MAD_K = 4.0
@@ -119,6 +133,9 @@ ABS_FLOORS = {
     # history — ONE fire is the smallest shift worth a human, so the
     # floor sits just under it (worse must EXCEED the threshold)
     "signals fired": 0.5,
+    # round 25: the hit rate is a fraction with the same jitter shape
+    # as the r20/r22 shares
+    "prefix hit frac": 0.05,
 }
 
 

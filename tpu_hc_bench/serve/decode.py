@@ -402,6 +402,27 @@ def init_kv_state(family: _Family, num_pages: int, page_size: int,
     return init_kv_pages(family, num_pages, page_size, dtype)
 
 
+def build_page_copy_fn():
+    """The copy-on-write program (round 25): duplicate physical page
+    ``src`` into ``dst`` across every KV leaf, all layers at once.
+
+    Every carry leaf — f32/int8 pools ``[L, pages, ps, kvh, d]`` AND
+    the int8_kv per-(layer, page) scale planes ``[L, pages]`` — indexes
+    pages on axis 1, so one tree_map covers both quant arms; an int8
+    page is copied in its final quantized layout, scale and all (no
+    dequant round-trip).  Args at call time: ``(kv, src [], dst [])``;
+    one AOT program per engine (page count is baked into the pool
+    shapes, not the program), warmed beside the decode buckets so a
+    first mid-traffic COW is never a compile.
+    """
+
+    def page_copy(kv, src, dst):
+        return jax.tree_util.tree_map(
+            lambda x: x.at[:, dst].set(x[:, src]), kv)
+
+    return page_copy
+
+
 def _write_quantized_chunks(pages_q, scales, new, table, length,
                             page_size, table_width):
     """Prefill's int8 page write: ``new`` [L, s, kvh, d] chunked into
@@ -461,6 +482,18 @@ def build_prefill_fn(family: _Family, page_size: int, table_width: int,
     kv)`` with the prompt's K/V scattered into the table's pages (pad
     positions routed to the trash page 0; int8 pools get per-page
     scales from the chunked write).
+
+    ``table`` here is the WRITE table, and that is the prefix-cache
+    seam (round 25): a cache-hit admission passes a copy with the
+    shared slots zeroed, so their stores route to the trash page —
+    the shared physical pages already hold bitwise-identical K/V from
+    the prefill that populated them — while the full dense pass still
+    runs (``next_token`` needs attention over every prompt position)
+    and the request's DECODE table keeps the real shared page ids.
+    Skipping a shared slot is a page-table edit, never a new program;
+    under int8_kv the same routing skips the quantized chunk store,
+    so a cached page is quantized once and shared in its final
+    int8+scale layout.
     """
     from tpu_hc_bench.parallel.sequence import dense_attention
 

@@ -98,9 +98,33 @@ def pick_bucket(ladder: tuple[int, ...], n: int) -> int:
 
 
 class PageAllocator:
-    """Free-list allocator over the KV page pool; page 0 is the
-    reserved trash page (padded/inactive rows read and write it) and is
-    never handed out."""
+    """Refcounted free-list allocator over the KV page pool; page 0 is
+    the reserved trash page (padded/inactive rows read and write it)
+    and is never handed out.
+
+    Round 25 makes pages a SHARED resource: a physical page can be
+    held by several requests (a prefix-cache hit) and by the cache
+    itself, so every holder takes a reference (``alloc``/``share``)
+    and drops it through ``free`` — a page returns to the free list
+    only when its last holder lets go.  All page-table stores and
+    free-list motion live inside this class (``bind`` is the one
+    sanctioned table store); the ``page-refcount-discipline`` lint
+    pins that invariant at the source level, because a bare
+    ``free_list.append`` beside a nonzero refcount is exactly the
+    silent-corruption class COW introduces.
+
+    Counter semantics (the r22 ``obs timeline`` counter track reads
+    these, so they must stay honest):
+
+    - ``recycled`` counts a page handed out again by ``alloc`` after a
+      genuine free — the pool-churn signal a leak (pages freed but
+      never reused) hides.
+    - ``cow_copies`` counts copy-on-write page duplications
+      (``cow_alloc``).  A COW is NOT a recycle: the page it pops was
+      already churned through ``alloc``'s account when it last left
+      the free list, and folding copies into ``recycled`` would read
+      as pool churn when it is sharing traffic.
+    """
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
@@ -109,13 +133,11 @@ class PageAllocator:
                 f"page): {num_pages}")
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, 0, -1))
-        # round 22 ledger counters (host ints the kv_pool record stamps
-        # for free): pool high-water in pages-in-use, and recycled
-        # allocations — a page handed out again after a free, the
-        # pool-churn signal a leak (pages freed but never reused) hides
         self.pages_peak = 0
         self.recycled = 0
+        self.cow_copies = 0
         self._ever_used = [False] * num_pages
+        self._refcount = [0] * num_pages
 
     @property
     def free_pages(self) -> int:
@@ -125,21 +147,60 @@ class PageAllocator:
     def used_pages(self) -> int:
         return self.num_pages - 1 - len(self._free)
 
+    def _take(self, count_recycle: bool) -> int:
+        p = self._free.pop()
+        self._refcount[p] = 1
+        if self._ever_used[p]:
+            if count_recycle:
+                self.recycled += 1
+        else:
+            self._ever_used[p] = True
+        return p
+
     def alloc(self, n: int) -> list[int] | None:
         if n > len(self._free):
             return None
-        out = [self._free.pop() for _ in range(n)]
-        for p in out:
-            if self._ever_used[p]:
-                self.recycled += 1
-            else:
-                self._ever_used[p] = True
+        out = [self._take(count_recycle=True) for _ in range(n)]
         if self.used_pages > self.pages_peak:
             self.pages_peak = self.used_pages
         return out
 
+    def cow_alloc(self) -> int | None:
+        """One page for a copy-on-write duplication: counted under
+        ``cow_copies``, never ``recycled`` (see class docstring)."""
+        if not self._free:
+            return None
+        p = self._take(count_recycle=False)
+        self.cow_copies += 1
+        if self.used_pages > self.pages_peak:
+            self.pages_peak = self.used_pages
+        return p
+
+    def share(self, pages: list[int]) -> None:
+        """One additional reference per page (a prefix-cache hit or
+        the cache's own retention hold)."""
+        for p in pages:
+            assert self._refcount[p] > 0, f"share of unheld page {p}"
+            self._refcount[p] += 1
+
+    def refcount(self, page: int) -> int:
+        return self._refcount[page]
+
     def free(self, pages: list[int]) -> None:
-        self._free.extend(pages)
+        """Drop one reference per page; a page rejoins the free list
+        at refcount zero (sole-holder frees behave exactly like the
+        pre-r25 allocator)."""
+        for p in pages:
+            assert self._refcount[p] > 0, f"free of unheld page {p}"
+            self._refcount[p] -= 1
+            if self._refcount[p] == 0:
+                self._free.append(p)
+
+    def bind(self, table: np.ndarray, slot: int, page: int) -> None:
+        """The one sanctioned page-table store: point ``table[slot]``
+        at a page this allocator has handed out and still tracks."""
+        assert self._refcount[page] > 0, f"bind of unheld page {page}"
+        table[slot] = page
 
 
 class KVLedger:
@@ -168,6 +229,12 @@ class KVLedger:
     def admit(self, pages_reserved: int, prompt_len: int) -> None:
         self.reserved_now += pages_reserved
         self.written_now += -(-prompt_len // self.page_size)
+
+    def grow(self, n: int = 1) -> None:
+        """Round 25 on-demand growth: pages taken mid-flight extend the
+        holder's reservation from the moment they are bound (written
+        follows through ``token`` when the boundary token lands)."""
+        self.reserved_now += n
 
     def token(self, length_before: int) -> None:
         # one appended token touches a new page iff the pre-append
@@ -250,6 +317,11 @@ class _InFlight:
     # the request by >= 1 token)
     preempts: int = 0
     produced_res: int = 0
+    # round 25 (lazy reservation + prefix sharing): pages grown on
+    # demand after admission, and page slots admitted pointing at
+    # shared prefix-cache pages — the footprint record stamps both
+    pages_grown: int = 0
+    prefix_shared: int = 0
 
 
 class ServeEngine:
@@ -309,6 +381,12 @@ class ServeEngine:
                 "requests; --decode_attention/--quant/"
                 "--decode_block_pages shape the paged decode step and "
                 "have no meaning here")
+        if not self.decode_mode and (
+                cfg.kv_reserve != "worst" or cfg.prefix_cache != "off"):
+            raise ValueError(
+                f"--model {cfg.model} serves single-forward classify "
+                "requests with no KV pool; --kv_reserve/--prefix_cache "
+                "shape paged-decode admission and have no meaning here")
 
         dtype = jnp.dtype(cfg.compute_dtype)
         if self.decode_mode:
@@ -514,6 +592,11 @@ class ServeEngine:
                       np.zeros((b,), np.int32), np.zeros((b, w), np.int32),
                       np.zeros((b,), np.int32), np.zeros((b,), bool),
                       donate=(1,))
+        # round 25: the one COW program — page-count-shaped, not
+        # bucket-shaped, so a single warmup covers every copy the
+        # prefix cache can ever trigger (zero lowering after warmup)
+        self._aot(("page_copy", 0), decode_mod.build_page_copy_fn(),
+                  self._kv, np.int32(0), np.int32(0), donate=(0,))
 
     def _warm_classify(self) -> None:
         model = self.model
@@ -552,7 +635,8 @@ class ServeEngine:
     def run(self, requests: list[Request], batching: str | None = None,
             writer: obs_metrics.MetricsWriter | None = None,
             clock=None, fleet=None, *, faults=None, shed=None,
-            deadline_ms=None, kv_preempt=None, journal_path=None,
+            deadline_ms=None, kv_preempt=None, kv_reserve=None,
+            prefix_cache=None, journal_path=None,
             drain_handler=None, step_timeout_s=None,
             on_watchdog=None) -> dict:
         """Play a request trace; returns the serve summary record.
@@ -585,6 +669,25 @@ class ServeEngine:
         shed = shed if shed is not None else self.cfg.shed
         kv_preempt = (kv_preempt if kv_preempt is not None
                       else self.cfg.kv_preempt)
+        # round 25: the reservation/sharing arms override per run like
+        # the other policy knobs — the three-arm kv bench drives all of
+        # worst / lazy / lazy+prefix through ONE warmed engine
+        kv_reserve = (kv_reserve if kv_reserve is not None
+                      else self.cfg.kv_reserve)
+        prefix_cache = (prefix_cache if prefix_cache is not None
+                        else self.cfg.prefix_cache)
+        if kv_reserve not in ("worst", "lazy"):
+            raise ValueError(
+                f"kv_reserve must be worst|lazy: {kv_reserve!r}")
+        if prefix_cache not in ("off", "on"):
+            raise ValueError(
+                f"prefix_cache must be off|on: {prefix_cache!r}")
+        if prefix_cache == "on" and kv_reserve != "lazy":
+            raise ValueError(
+                "prefix_cache=on requires kv_reserve=lazy (sharing "
+                "only saves pages when admission stops reserving the "
+                "worst case)")
+        headroom = self.cfg.kv_growth_headroom
         deadline_ms = (deadline_ms if deadline_ms is not None
                        else (self.cfg.deadline_ms or self.cfg.slo_e2e_ms))
         if shed not in ("off", "admit", "deadline"):
@@ -599,6 +702,12 @@ class ServeEngine:
                 f"--model {self.cfg.model} serves single-forward "
                 "classify requests; --serve_faults/--kv_preempt drive "
                 "the paged decode path and have no meaning here")
+        if not self.decode_mode and (kv_reserve != "worst"
+                                     or prefix_cache != "off"):
+            raise ValueError(
+                f"--model {self.cfg.model} serves single-forward "
+                "classify requests with no KV pool; "
+                "--kv_reserve/--prefix_cache have no meaning here")
         # the quarantine guard arms with EITHER policy knob: reading
         # logits back is one host transfer per step that the unarmed
         # lane must not pay (an injected NaN with both knobs off flows
@@ -614,6 +723,18 @@ class ServeEngine:
         allocator = PageAllocator(self.num_pages) if self.decode_mode \
             else None
         ledger = KVLedger(self.page_size) if self.decode_mode else None
+        # round 25: the shared-prefix cache lives per run (it holds
+        # references into THIS run's allocator) and its counters feed
+        # prefix_hit_frac on the kv_pool record cadence
+        cache = None
+        if self.decode_mode and prefix_cache == "on":
+            from tpu_hc_bench.serve import prefix_cache as prefix_mod
+
+            cache = prefix_mod.PrefixCache(allocator, self.page_size)
+        pages_grown_total = 0
+        prefix_hits = 0
+        prefix_lookups = 0
+        prefix_shared_total = 0
         # queue-wait cause split (round 22): rid -> accumulated seconds
         # blocked on [pool_starved, batch_full] while sitting in queue
         wait_causes: dict[int, list[float]] = {}
@@ -736,6 +857,27 @@ class ServeEngine:
             last_productive = productive_s
             win_idx += 1
 
+        def kv_pool_event() -> None:
+            """One pool-ledger snapshot (the periodic cadence and the
+            terminal flush share it): counters the engine already
+            holds, no device round-trips.  Round 25 adds the growth/
+            sharing/COW counters — pre-r25 readers see the keys as
+            absent and normalize to 0."""
+            writer.event(
+                "kv_pool", t=round(now(), 4),
+                pages_reserved=ledger.reserved_now,
+                pages_written=ledger.written_now,
+                free_pages=allocator.free_pages,
+                pages_peak=allocator.pages_peak,
+                pages_recycled=allocator.recycled,
+                reserved_page_s=round(ledger.reserved_page_s, 6),
+                written_page_s=round(ledger.written_page_s, 6),
+                pages_grown=pages_grown_total,
+                pages_cow=allocator.cow_copies,
+                prefix_hits=prefix_hits,
+                prefix_lookups=prefix_lookups,
+                prefix_pages_shared=prefix_shared_total)
+
         def bucket_acct(kind: str, bucket: int, active_rows: int,
                         dt: float) -> None:
             u = butil.setdefault(f"{kind}@{bucket}", [0, 0, 0, 0.0])
@@ -794,6 +936,12 @@ class ServeEngine:
                 rec["pages_reserved"] = len(fl.pages)
                 rec["pages_peak_used"] = final_pages
                 rec["pages_final"] = final_pages
+                # round 25 footprint fields (absent on pre-r25 records;
+                # readers normalize to 0, the r20/r22 seam): on-demand
+                # growths after admission, and slots admitted pointing
+                # at shared prefix-cache pages
+                rec["pages_grown"] = fl.pages_grown
+                rec["prefix_pages_shared"] = fl.prefix_shared
             if status == "ok":
                 if not fl.preempts:
                     # the predictive-shed service estimate: first-admit
@@ -955,8 +1103,37 @@ class ServeEngine:
             return {"journal": path, "unfinished": len(entries),
                     "reason": "sigterm"}
 
+        def feed_of(req: Request, c: dict | None) -> np.ndarray:
+            """The prefill token feed: the prompt, plus — for a
+            requeued preemption victim — its generated prefix minus
+            the newest token (the greedy pass regenerates that one,
+            so resumption is exact: zero tokens lost or duplicated)."""
+            if c and c["prefix"]:
+                return np.concatenate(
+                    [req.prompt,
+                     np.asarray(c["prefix"][:-1], np.int32)])
+            return req.prompt
+
+        def need_pages(req: Request) -> int:
+            """Pages admission must pull from the FREE list for this
+            request right now: the full table under worst-case
+            reservation; prompt + headroom minus the prefix-cache
+            cover under lazy (the cache peek is pure — acquire
+            happens inside admit in the same scheduler iteration)."""
+            if kv_reserve == "worst":
+                return self.table_width
+            c = carry.get(req.rid)
+            plen = req.prompt_len + (max(0, len(c["prefix"]) - 1)
+                                     if c else 0)
+            slots = min(self.table_width,
+                        -(-plen // self.page_size) + headroom)
+            if cache is not None:
+                slots -= cache.match(feed_of(req, c)).slots
+            return max(0, slots)
+
         def admit(req: Request) -> None:
             nonlocal kv, tokens_out, productive_s
+            nonlocal prefix_hits, prefix_lookups, prefix_shared_total
             t_admit = now()
             c = carry.pop(req.rid, None)
             timeline_mod.instant("admit", rid=req.rid)
@@ -965,31 +1142,53 @@ class ServeEngine:
                                         table=np.zeros(0, np.int32),
                                         t_admit=t_admit))
                 return
-            pages = allocator.alloc(self.table_width)
-            assert pages is not None, "admission checked free_pages"
-            table = np.asarray(pages, np.int32)
             prefix = c["prefix"] if c else []
             if c:
-                # requeued victim: re-prefill prompt + generated prefix
-                # minus its newest token — the greedy pass regenerates
-                # that one (decode/prefill parity), so the request
-                # resumes exactly where preemption cut it, zero tokens
-                # lost and zero duplicated
-                feed = np.concatenate(
-                    [req.prompt, np.asarray(prefix[:-1], np.int32)])
                 degrade["requeues"] += 1
-            else:
-                feed = req.prompt
+            feed = feed_of(req, c)
             plen = int(len(feed))
+            shared: list[int] = []
+            m = None
+            if cache is not None:
+                prefix_lookups += 1
+                m = cache.match(feed)
+                if m.slots:
+                    prefix_hits += 1
+                    shared = cache.acquire(m)
+                    prefix_shared_total += len(shared)
+            if kv_reserve == "lazy":
+                # reserve only what the prompt needs plus decode
+                # headroom; every later page is an on-demand growth
+                slots = min(self.table_width,
+                            -(-plen // self.page_size) + headroom)
+            else:
+                slots = self.table_width
+            fresh = allocator.alloc(max(0, slots - len(shared)))
+            assert fresh is not None, "admission checked free_pages"
+            pages = shared + fresh
+            table = np.pad(np.asarray(pages, np.int32),
+                           (0, self.table_width - len(pages)))
             ledger.admit(len(pages), plen)
             s = pick_bucket(self.prefill_buckets, plen)
             toks = np.zeros((1, s), np.int32)
             toks[0, :plen] = feed
+            wtable = table
+            if shared:
+                # the prefill-skip seam: shared slots' physical pages
+                # already hold this prefix's K/V bitwise (same params,
+                # same absolute positions, deterministic prefill), so
+                # the WRITE table routes their stores to trash page 0
+                # — the decode table keeps the real shared ids.  The
+                # dense pass itself still runs: next_token attends
+                # over every prompt position either way.
+                wtable = np.where(
+                    np.arange(self.table_width) < len(shared),
+                    0, table).astype(np.int32)
             (next_tok, logits, kv), dt = self._timed(
                 clock, "prefill",
                 lambda: self.compiled[("prefill", s)](
                     self.exec_params, kv, toks,
-                    np.int32(plen), table))
+                    np.int32(plen), wtable))
             # host-side numpy view BEFORE indexing: jax.Array.__getitem__
             # dispatches a jitted gather — a post-warmup compile the
             # zero-recompile contract (and the cache-entry assertion)
@@ -1013,7 +1212,8 @@ class ServeEngine:
                 active_s=(c["active_s"] + dt if c else 0.0),
                 t_last=(c["t_last"] if c else None),
                 preempts=(c["preempts"] if c else 0),
-                produced_res=(0 if c else 1))
+                produced_res=(0 if c else 1),
+                prefix_shared=len(shared))
             if guard:
                 row = np.asarray(logits)
                 if faults is not None and faults.poison_rids([req.rid]):
@@ -1024,6 +1224,13 @@ class ServeEngine:
                     finish(fl, now(), status="quarantined",
                            cause="nonfinite_logits")
                     return
+            if cache is not None:
+                # seed the trie with this prefill's pages (a finite,
+                # non-quarantined pass only): full chunks as nodes,
+                # the partial tail under its exact-token key — the
+                # cache's own reference keeps them alive past this
+                # request's retirement
+                cache.insert(feed, pages, plen)
             if fl.produced >= req.output_len:
                 finish(fl, now(), status="ok")
             else:
@@ -1034,7 +1241,51 @@ class ServeEngine:
             writer.event("injected_fault", fault="nan_logits", rid=rid,
                          where=where)
 
-        def decode_step() -> None:
+        def ensure_capacity(fl: _InFlight) -> bool:
+            """Round 25 growth/COW pre-pass for one resident: make this
+            step's append slot a writable, exclusively-owned page.
+            Crossing a page boundary allocates from the free list AT
+            THAT MOMENT (on-demand growth); the first append into a
+            shared page duplicates it through the warmed page-copy
+            program (copy-on-write).  Returns False to PAUSE the row
+            this step — its batch slot masks off and nothing is
+            written, so the next step retries after eviction,
+            preemption, or a retirement frees pages."""
+            nonlocal kv, pages_grown_total
+            slot = fl.length // self.page_size
+            if slot >= len(fl.pages):
+                if free_now() < 1 and cache is not None:
+                    cache.evict(1)
+                if free_now() < 1:
+                    return False
+                grown = allocator.alloc(1)
+                allocator.bind(fl.table, slot, grown[0])
+                fl.pages.append(grown[0])
+                ledger.grow(1)
+                fl.pages_grown += 1
+                pages_grown_total += 1
+                return True
+            page = fl.pages[slot]
+            if allocator.refcount(page) == 1:
+                return True
+            # shared tail page (this holder + the cache and/or other
+            # residents): copy before the write
+            if free_now() < 1 and cache is not None:
+                cache.evict(1)
+            if free_now() < 1:
+                return False
+            dst = allocator.cow_alloc()
+            (kv), dt = self._timed(
+                clock, "page_copy",
+                lambda: self.compiled[("page_copy", 0)](
+                    kv, np.int32(page), np.int32(dst)))
+            ledger.charge(dt)
+            allocator.bind(fl.table, slot, dst)
+            fl.pages[slot] = dst
+            allocator.free([page])
+            return True
+
+        def decode_step() -> bool:
             nonlocal kv, tokens_out, productive_s
             if faults is not None:
                 hang_s = faults.hang_before_decode(steps["decode"] + 1)
@@ -1048,12 +1299,23 @@ class ServeEngine:
                     # host signature the watchdog's (real-time)
                     # progress oracle exists to catch
                     time.sleep(hang_s)
-            b = pick_bucket(self.batch_buckets, len(active))
+            sched = active
+            if kv_reserve == "lazy" or cache is not None:
+                sched = [fl for fl in active if ensure_capacity(fl)]
+                if not sched and active and kv_preempt == "on" \
+                        and preempt_one():
+                    # every resident paused on growth: the r23
+                    # machinery frees a victim's pages and the rest
+                    # retry in the same step
+                    sched = [fl for fl in active if ensure_capacity(fl)]
+                if not sched:
+                    return False
+            b = pick_bucket(self.batch_buckets, len(sched))
             toks = np.zeros((b,), np.int32)
             tables = np.zeros((b, self.table_width), np.int32)
             lengths = np.zeros((b,), np.int32)
             mask = np.zeros((b,), bool)
-            for i, fl in enumerate(active):
+            for i, fl in enumerate(sched):
                 toks[i] = fl.last_token
                 tables[i] = fl.table
                 lengths[i] = fl.length
@@ -1063,9 +1325,9 @@ class ServeEngine:
                 lambda: self.compiled[("decode", b)](
                     self.exec_params, kv, toks, tables, lengths, mask))
             steps["decode"] += 1
-            tokens_out += len(active)
-            productive_s += dt * (len(active) / b)
-            bucket_acct("decode", b, len(active), dt)
+            tokens_out += len(sched)
+            productive_s += dt * (len(sched) / b)
+            bucket_acct("decode", b, len(sched), dt)
             ledger.charge(dt)
             next_toks = np.asarray(next_toks)
             bad: set[int] = set()
@@ -1074,26 +1336,27 @@ class ServeEngine:
                 # logits, rows checked independently — a poisoned
                 # request retires alone, batch-mates keep their
                 # (finite) tokens
-                lg = np.asarray(logits)[:len(active)]
+                lg = np.asarray(logits)[:len(sched)]
                 hit = (set(faults.poison_rids(
-                    [fl.req.rid for fl in active]))
+                    [fl.req.rid for fl in sched]))
                     if faults is not None else set())
                 if hit:
                     lg = np.array(lg)   # writable copy to poison
-                    for i, fl in enumerate(active):
+                    for i, fl in enumerate(sched):
                         if fl.req.rid in hit:
                             lg[i] = np.nan
                             announce_nan(fl.req.rid, "decode")
                 finite = np.isfinite(lg.reshape(len(lg), -1)).all(axis=1)
-                bad = {i for i in range(len(active)) if not finite[i]}
+                bad = {i for i in range(len(sched)) if not finite[i]}
             t_done = now()
-            still: list[_InFlight] = []
-            for i, fl in enumerate(active):
+            dropped: set[int] = set()
+            for i, fl in enumerate(sched):
                 fl.active_s += dt
                 fl.t_last = t_done
                 if i in bad:
                     finish(fl, t_done, status="quarantined",
                            cause="nonfinite_logits")
+                    dropped.add(fl.req.rid)
                     continue
                 fl.last_token = int(next_toks[i])
                 fl.out_tokens.append(fl.last_token)
@@ -1103,9 +1366,13 @@ class ServeEngine:
                 fl.produced_res += 1
                 if fl.produced >= fl.req.output_len:
                     finish(fl, t_done, status="ok")
-                else:
-                    still.append(fl)
-            active[:] = still
+                    dropped.add(fl.req.rid)
+            if dropped:
+                # paused rows (not in sched) keep their place; retire
+                # by rid, not list rebuild from sched
+                active[:] = [fl for fl in active
+                             if fl.req.rid not in dropped]
+            return True
 
         def classify_step() -> None:
             nonlocal tokens_out, productive_s
@@ -1227,9 +1494,15 @@ class ServeEngine:
                             progressed = True
                             continue
                         if allocator is None \
-                                or free_now() >= self.table_width:
+                                or free_now() >= need_pages(head):
                             admit(queue.popleft())
                             progressed = True
+                            continue
+                        # starved: reclaim cold cache pages first (they
+                        # are free capacity the trie is merely keeping
+                        # warm), then the r23 preemption machinery
+                        if cache is not None and cache.evict(
+                                need_pages(head) - free_now()):
                             continue
                         if kv_preempt == "on" and preempt_one():
                             progressed = True
@@ -1266,7 +1539,7 @@ class ServeEngine:
                     elif len(active) >= self.cap:
                         blocked_cause = "batch_full"
                     elif allocator is not None and \
-                            free_now() < self.table_width:
+                            free_now() < need_pages(queue[0]):
                         blocked_cause = "pool_starved"
                 if blocked_cause != last_blocked:
                     # edge-triggered flight-recorder instants: the
@@ -1281,16 +1554,23 @@ class ServeEngine:
                     last_blocked = blocked_cause
                 t_blocked = now()
                 if active:
-                    decode_step() if self.decode_mode \
-                        else classify_step()
-                    progressed = True
+                    if self.decode_mode:
+                        # a False return means every resident paused on
+                        # growth/COW starvation — not progress
+                        if decode_step():
+                            progressed = True
+                    else:
+                        classify_step()
+                        progressed = True
                 if not progressed:
                     if idx >= n:
                         if shed == "off" or not queue:
                             raise RuntimeError(
-                                "serve engine stalled: queued requests, "
-                                "nothing in flight, no capacity — KV "
-                                "pool undersized?")
+                                "serve engine stalled: no request can "
+                                "make progress — KV pool undersized? "
+                                "(under --kv_reserve=lazy, "
+                                "--kv_preempt=on frees pages by "
+                                "preempting the worst resident)")
                         # shedding armed: a squeezed pool can pin the
                         # queue with nothing resident — idle to the
                         # next deadline; the expiry pass drains it
@@ -1336,20 +1616,7 @@ class ServeEngine:
                             **{f"{k}_steps": v
                                for k, v in steps.items()})
                         if ledger is not None:
-                            # the pool ledger snapshot: counters the
-                            # engine already holds — no device
-                            # round-trips
-                            writer.event(
-                                "kv_pool", t=round(now(), 4),
-                                pages_reserved=ledger.reserved_now,
-                                pages_written=ledger.written_now,
-                                free_pages=allocator.free_pages,
-                                pages_peak=allocator.pages_peak,
-                                pages_recycled=allocator.recycled,
-                                reserved_page_s=round(
-                                    ledger.reserved_page_s, 6),
-                                written_page_s=round(
-                                    ledger.written_page_s, 6))
+                            kv_pool_event()
                     if fleet is not None:
                         fleet.heartbeat(
                             step=total_steps,
@@ -1375,15 +1642,7 @@ class ServeEngine:
         if ledger is not None and writer.enabled:
             # terminal ledger snapshot: runs shorter than one record
             # window still land their cumulative page-second integrals
-            writer.event(
-                "kv_pool", t=round(now(), 4),
-                pages_reserved=ledger.reserved_now,
-                pages_written=ledger.written_now,
-                free_pages=allocator.free_pages,
-                pages_peak=allocator.pages_peak,
-                pages_recycled=allocator.recycled,
-                reserved_page_s=round(ledger.reserved_page_s, 6),
-                written_page_s=round(ledger.written_page_s, 6))
+            kv_pool_event()
         if fleet is not None:
             fleet.heartbeat(
                 step=sum(steps.values()),
@@ -1406,6 +1665,11 @@ class ServeEngine:
                 written_page_s=ledger.written_page_s,
                 pages_peak=allocator.pages_peak,
                 pages_recycled=allocator.recycled,
+                pages_grown=pages_grown_total,
+                cow_copies=allocator.cow_copies,
+                prefix_hits=prefix_hits,
+                prefix_lookups=prefix_lookups,
+                prefix_pages_shared=prefix_shared_total,
                 request_records=list(done))
         summary = {
             "workload": "serve",
@@ -1433,6 +1697,11 @@ class ServeEngine:
             "kv_scale_bytes": self.kv_scale_bytes,
             "kv_pool": kv_fold,
             **kv_mod.flatten_kv(kv_fold),
+            # round 25: the reservation/sharing arms are config
+            # identity for this run (regress fingerprints on them)
+            "kv_reserve": (kv_reserve if self.decode_mode else None),
+            "prefix_cache": (prefix_cache if self.decode_mode
+                             else None),
             "decode_attention": (self.decode_attention
                                  if self.decode_mode else None),
             "quant": self.quant,

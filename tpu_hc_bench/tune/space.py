@@ -72,6 +72,13 @@ SERVE_LEVERS = (
     "decode_attention",
     "quant",
     "decode_block_pages",
+    # round 25: lazy reservation + prefix sharing — admission policy
+    # is a lever like any kernel arm (resolve() enforces the
+    # prefix_cache->lazy dependency at flag time, so the pruner never
+    # runs an invalid pairing)
+    "kv_reserve",
+    "prefix_cache",
+    "kv_growth_headroom",
 )
 
 # member -> best-known single-chip config (BASELINE.md zoo table).
